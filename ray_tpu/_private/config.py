@@ -1,0 +1,91 @@
+"""Runtime config registry.
+
+Equivalent of the reference's RAY_CONFIG X-macro registry
+(reference: src/ray/common/ray_config_def.h — 219 entries, env-overridable
+via RAY_<name> and cluster-wide via ray.init(_system_config=...)).
+
+Here: declarative entries overridable per-process via ``RT_<NAME>`` env vars
+and cluster-wide via ``ray_tpu.init(_system_config={...})`` (the dict is
+serialized and handed to every spawned daemon/worker).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+
+_DEFS: Dict[str, Any] = {}
+
+
+def _def(name: str, default: Any) -> None:
+    _DEFS[name] = default
+
+
+# --- scheduling -------------------------------------------------------------
+_def("max_direct_call_object_size", 100 * 1024)  # inline returns/args below this
+_def("worker_lease_timeout_ms", 30_000)
+_def("worker_pool_prestart_workers", 0)
+_def("worker_idle_timeout_ms", 60_000)
+_def("scheduler_top_k_fraction", 0.2)  # hybrid policy: top-k random among best
+_def("scheduler_spread_threshold", 0.5)
+_def("task_retry_delay_ms", 100)
+_def("actor_creation_retries", 3)
+# --- object store -----------------------------------------------------------
+_def("object_store_memory_bytes", 512 * 1024 * 1024)
+_def("object_store_fallback_directory", "/tmp/ray_tpu_spill")
+_def("object_spilling_threshold", 0.8)
+_def("object_transfer_chunk_bytes", 4 * 1024 * 1024)
+# --- control plane ----------------------------------------------------------
+_def("gcs_health_check_period_ms", 3_000)   # ref: ray_config_def.h:841-847
+_def("gcs_health_check_failure_threshold", 5)
+_def("pubsub_poll_timeout_ms", 30_000)
+_def("rpc_connect_timeout_s", 10.0)
+_def("rpc_call_timeout_s", 120.0)
+# --- workers ----------------------------------------------------------------
+_def("worker_register_timeout_s", 30.0)
+_def("worker_startup_parallelism", 4)
+# --- observability ----------------------------------------------------------
+_def("task_events_buffer_size", 10_000)
+_def("metrics_report_interval_ms", 5_000)
+_def("event_stats", True)
+
+
+class _Config:
+    def __init__(self):
+        self._overrides: Dict[str, Any] = {}
+
+    def initialize(self, system_config: Dict[str, Any] | None) -> None:
+        if system_config:
+            for k, v in system_config.items():
+                if k not in _DEFS:
+                    raise ValueError(f"Unknown system config key: {k}")
+                self._overrides[k] = v
+
+    def serialize(self) -> str:
+        return json.dumps(self._overrides)
+
+    @classmethod
+    def deserialize_into_env(cls, serialized: str) -> Dict[str, str]:
+        """Build the env-var dict to pass to a child process."""
+        overrides = json.loads(serialized)
+        return {f"RT_{k.upper()}": json.dumps(v) for k, v in overrides.items()}
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in _DEFS:
+            raise AttributeError(f"Unknown config: {name}")
+        env = os.environ.get(f"RT_{name.upper()}")
+        if env is not None:
+            try:
+                return json.loads(env)
+            except json.JSONDecodeError:
+                return env
+        if name in self._overrides:
+            return self._overrides[name]
+        return _DEFS[name]
+
+
+config = _Config()

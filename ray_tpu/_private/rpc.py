@@ -1,0 +1,311 @@
+"""Control-plane RPC: length-prefixed msgpack over asyncio TCP.
+
+Equivalent role to the reference's typed gRPC wrappers
+(reference: src/ray/rpc/grpc_server.h, grpc_client.h) — every daemon
+(control service, node agent, worker) exposes one RPC server; clients are
+pooled and retryable.  We use a compact msgpack framing instead of gRPC:
+the control plane carries small messages (leases, directory updates,
+heartbeats); bulk data rides the object plane, never RPC.
+
+Frame:  [u32 length][msgpack (kind, req_id, method, payload)]
+  kind: 0=request, 1=reply, 2=error, 3=oneway
+Payload is a msgpack-native structure (dict/list/bytes/str/int/float).
+
+Servers subclass `RpcHost` and define ``async def rpc_<method>(self, **kw)``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import struct
+import threading
+from typing import Any, Dict, Optional
+
+import msgpack
+
+_REQUEST, _REPLY, _ERROR, _ONEWAY = 0, 1, 2, 3
+_LEN = struct.Struct("<I")
+_MAX_FRAME = 1 << 30
+
+
+class RpcError(Exception):
+    """Remote handler raised; message carries the remote traceback string."""
+
+
+class ConnectionLost(Exception):
+    pass
+
+
+def _pack(kind: int, req_id: int, method: str, payload: Any) -> bytes:
+    body = msgpack.packb((kind, req_id, method, payload), use_bin_type=True)
+    return _LEN.pack(len(body)) + body
+
+
+async def _read_frame(reader: asyncio.StreamReader):
+    header = await reader.readexactly(_LEN.size)
+    (length,) = _LEN.unpack(header)
+    if length > _MAX_FRAME:
+        raise ConnectionLost(f"oversized frame: {length}")
+    body = await reader.readexactly(length)
+    return msgpack.unpackb(body, raw=False, strict_map_key=False)
+
+
+class RpcHost:
+    """Base for RPC-serving daemons. Handlers: ``async def rpc_<name>``."""
+
+    async def dispatch(self, method: str, payload: Dict[str, Any]) -> Any:
+        handler = getattr(self, f"rpc_{method}", None)
+        if handler is None:
+            raise RpcError(f"{type(self).__name__} has no method {method!r}")
+        return await handler(**(payload or {}))
+
+    def on_peer_disconnect(self, peer: "RpcServerConnection") -> None:
+        """Override to observe client disconnects (e.g. worker death)."""
+
+
+class RpcServerConnection:
+    def __init__(self, writer: asyncio.StreamWriter):
+        self.writer = writer
+        self.meta: Dict[str, Any] = {}  # set by register handlers
+
+    async def push(self, method: str, payload: Any) -> None:
+        """Server→client oneway push (used for pubsub, task push)."""
+        self.writer.write(_pack(_ONEWAY, 0, method, payload))
+        await self.writer.drain()
+
+
+class RpcServer:
+    def __init__(self, host_obj: RpcHost, listen_host: str = "127.0.0.1", port: int = 0):
+        self._host_obj = host_obj
+        self._listen_host = listen_host
+        self._port = port
+        self._server: Optional[asyncio.base_events.Server] = None
+        self.connections: set[RpcServerConnection] = set()
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self._listen_host, self._port
+        )
+        self._port = self._server.sockets[0].getsockname()[1]
+        return self._port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle_conn(self, reader, writer):
+        conn = RpcServerConnection(writer)
+        self.connections.add(conn)
+        try:
+            while True:
+                try:
+                    kind, req_id, method, payload = await _read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError, ConnectionLost):
+                    break
+                if kind == _ONEWAY:
+                    asyncio.ensure_future(self._run_oneway(conn, method, payload))
+                elif kind == _REQUEST:
+                    asyncio.ensure_future(
+                        self._run_request(conn, writer, req_id, method, payload)
+                    )
+        finally:
+            self.connections.discard(conn)
+            try:
+                self._host_obj.on_peer_disconnect(conn)
+            except Exception:
+                pass
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _run_oneway(self, conn, method, payload):
+        try:
+            payload = dict(payload or {})
+            payload["_conn"] = conn
+            await self._host_obj.dispatch(method, payload)
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+
+    async def _run_request(self, conn, writer, req_id, method, payload):
+        try:
+            payload = dict(payload or {})
+            if self._wants_conn(method):
+                payload["_conn"] = conn
+            result = await self._host_obj.dispatch(method, payload)
+            out = _pack(_REPLY, req_id, method, result)
+        except Exception as e:
+            import traceback
+
+            out = _pack(_ERROR, req_id, method, f"{e}\n{traceback.format_exc()}")
+        try:
+            writer.write(out)
+            await writer.drain()
+        except (ConnectionResetError, RuntimeError):
+            pass
+
+    def _wants_conn(self, method: str) -> bool:
+        handler = getattr(self._host_obj, f"rpc_{method}", None)
+        if handler is None:
+            return False
+        code = getattr(handler, "__code__", None)
+        return bool(code and "_conn" in code.co_varnames)
+
+
+class RpcClient:
+    """Async client with reconnect-on-demand and push-message callback."""
+
+    def __init__(self, host: str, port: int, on_push=None, label: str = ""):
+        self.host, self.port = host, port
+        self._on_push = on_push
+        self._label = label
+        self._reader = None
+        self._writer = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._req_ids = itertools.count(1)
+        self._read_task = None
+        self._lock = asyncio.Lock()
+
+    @property
+    def connected(self) -> bool:
+        return self._writer is not None
+
+    async def connect(self) -> None:
+        from ray_tpu._private.config import config
+
+        async with self._lock:
+            if self._writer is not None:
+                return
+            self._reader, self._writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port),
+                timeout=config.rpc_connect_timeout_s,
+            )
+            self._read_task = asyncio.ensure_future(self._read_loop())
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        if self._read_task:
+            self._read_task.cancel()
+            self._read_task = None
+
+    async def _read_loop(self):
+        try:
+            while True:
+                kind, req_id, method, payload = await _read_frame(self._reader)
+                if kind in (_REPLY, _ERROR):
+                    fut = self._pending.pop(req_id, None)
+                    if fut is not None and not fut.done():
+                        if kind == _REPLY:
+                            fut.set_result(payload)
+                        else:
+                            fut.set_exception(RpcError(payload))
+                elif kind == _ONEWAY and self._on_push is not None:
+                    try:
+                        res = self._on_push(method, payload)
+                        if asyncio.iscoroutine(res):
+                            asyncio.ensure_future(res)
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()
+        except (asyncio.IncompleteReadError, ConnectionResetError, asyncio.CancelledError):
+            pass
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+        finally:
+            self._writer = None
+            err = ConnectionLost(f"connection to {self._label or self.host}:{self.port} lost")
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(err)
+            self._pending.clear()
+
+    async def call(self, method: str, timeout: Optional[float] = None, **payload) -> Any:
+        from ray_tpu._private.config import config
+
+        if self._writer is None:
+            await self.connect()
+        req_id = next(self._req_ids)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        self._writer.write(_pack(_REQUEST, req_id, method, payload))
+        await self._writer.drain()
+        return await asyncio.wait_for(
+            fut, timeout if timeout is not None else config.rpc_call_timeout_s
+        )
+
+    async def oneway(self, method: str, **payload) -> None:
+        if self._writer is None:
+            await self.connect()
+        self._writer.write(_pack(_ONEWAY, 0, method, payload))
+        await self._writer.drain()
+
+
+class EventLoopThread:
+    """A dedicated asyncio loop thread.
+
+    Worker/driver processes execute user code on the main thread; all their
+    RPC (server + clients) runs here.  Mirrors the role of the reference
+    core worker's io_service thread (reference: src/ray/core_worker/
+    core_worker_process.h — the boost::asio io context).
+    """
+
+    def __init__(self, name: str = "rt-io"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout: Optional[float] = None):
+        """Run coroutine on the loop from a foreign thread, blocking."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def spawn(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=5)
+
+
+class SyncRpcClient:
+    """Blocking facade over RpcClient for use from the main thread."""
+
+    def __init__(self, host: str, port: int, io: EventLoopThread, on_push=None, label=""):
+        self._io = io
+        self._client = RpcClient(host, port, on_push=on_push, label=label)
+
+    @property
+    def aio(self) -> RpcClient:
+        return self._client
+
+    def call(self, method: str, timeout: Optional[float] = None, **payload) -> Any:
+        return self._io.run(
+            self._client.call(method, timeout=timeout, **payload),
+            timeout=None,
+        )
+
+    def oneway(self, method: str, **payload) -> None:
+        self._io.run(self._client.oneway(method, **payload))
+
+    def close(self):
+        try:
+            self._io.run(self._client.close(), timeout=5)
+        except Exception:
+            pass

@@ -1,0 +1,29 @@
+"""Test fixtures.
+
+Multi-device tests run on a virtual 8-device CPU mesh
+(reference test strategy: SURVEY.md §4.3 — JAX CPU
+``xla_force_host_platform_device_count`` emulates multi-device meshes
+without hardware; the driver dry-runs the real multi-chip path).
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process tree.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def local_cluster():
+    """A started single-node ray_tpu cluster; shuts down after the test."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    try:
+        yield ray_tpu
+    finally:
+        ray_tpu.shutdown()

@@ -1,0 +1,164 @@
+"""Tests for IDs, config, serialization, RPC (layer L1)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import config
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID
+from ray_tpu._private.rpc import (
+    EventLoopThread,
+    RpcClient,
+    RpcError,
+    RpcHost,
+    RpcServer,
+    SyncRpcClient,
+)
+
+
+class TestIDs:
+    def test_lineage_embedding(self):
+        job = JobID.from_int(7)
+        actor = ActorID.of(job)
+        assert actor.job_id() == job
+        task = TaskID.for_actor_task(actor)
+        assert task.actor_id() == actor
+        assert task.job_id() == job
+        obj = ObjectID.from_index(task, 1)
+        assert obj.task_id() == task
+        assert obj.index() == 1
+        assert obj.job_id() == job
+
+    def test_normal_task_has_nil_actor(self):
+        task = TaskID.for_normal_task(JobID.from_int(3))
+        assert task.actor_id().binary()[:12] == b"\x00" * 12
+        assert task.job_id() == JobID.from_int(3)
+
+    def test_hex_roundtrip_and_hash(self):
+        n = NodeID.from_random()
+        assert NodeID.from_hex(n.hex()) == n
+        assert len({n, NodeID.from_hex(n.hex())}) == 1
+        assert not n.is_nil()
+        assert NodeID.nil().is_nil()
+
+
+class TestConfig:
+    def test_defaults_and_env_override(self, monkeypatch):
+        assert config.max_direct_call_object_size == 100 * 1024
+        monkeypatch.setenv("RT_MAX_DIRECT_CALL_OBJECT_SIZE", "5")
+        assert config.max_direct_call_object_size == 5
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(AttributeError):
+            config.not_a_real_key
+
+
+class TestSerialization:
+    def test_roundtrip_python(self):
+        val = {"a": [1, 2, (3, "x")], "b": None}
+        data = serialization.serialize_to_bytes(val)
+        assert serialization.deserialize(data) == val
+
+    def test_numpy_out_of_band_zero_copy(self):
+        arr = np.arange(1 << 16, dtype=np.float32)
+        frames, size = serialization.serialize(arr)
+        # array payload must be out-of-band, not inside the pickle frame
+        assert len(frames) >= 2
+        assert frames[0].nbytes < 4096
+        buf = bytearray(size)
+        serialization.pack_into(frames, memoryview(buf))
+        out = serialization.deserialize(memoryview(buf))
+        np.testing.assert_array_equal(out, arr)
+        # zero-copy: deserialized array views into the packed buffer
+        assert out.base is not None
+
+    def test_alignment(self):
+        arr = np.ones(1000, dtype=np.float64)
+        data = serialization.serialize_to_bytes(("pre", arr))
+        out = serialization.deserialize(data)
+        assert out[1].ctypes.data % 64 == 0
+
+    def test_closure(self):
+        x = 41
+
+        def f(y):
+            return x + y
+
+        g = serialization.deserialize(serialization.serialize_to_bytes(f))
+        assert g(1) == 42
+
+
+class _EchoHost(RpcHost):
+    def __init__(self):
+        self.pushes = []
+
+    async def rpc_echo(self, value=None):
+        return {"value": value}
+
+    async def rpc_fail(self):
+        raise ValueError("boom")
+
+    async def rpc_note(self, value=None, _conn=None):
+        self.pushes.append(value)
+
+    async def rpc_push_back(self, _conn=None):
+        await _conn.push("server_event", {"n": 1})
+        return {}
+
+
+class TestRpc:
+    def test_request_reply_and_error(self):
+        async def main():
+            host = _EchoHost()
+            server = RpcServer(host)
+            port = await server.start()
+            client = RpcClient("127.0.0.1", port)
+            out = await client.call("echo", value={"k": [1, 2, b"raw"]})
+            assert out == {"value": {"k": [1, 2, b"raw"]}}
+            with pytest.raises(RpcError, match="boom"):
+                await client.call("fail")
+            # concurrency: many in-flight requests on one connection
+            outs = await asyncio.gather(
+                *[client.call("echo", value=i) for i in range(50)]
+            )
+            assert [o["value"] for o in outs] == list(range(50))
+            await client.close()
+            await server.stop()
+
+        asyncio.run(main())
+
+    def test_oneway_and_server_push(self):
+        async def main():
+            host = _EchoHost()
+            server = RpcServer(host)
+            port = await server.start()
+            got = asyncio.Event()
+            events = []
+
+            def on_push(method, payload):
+                events.append((method, payload))
+                got.set()
+
+            client = RpcClient("127.0.0.1", port, on_push=on_push)
+            await client.oneway("note", value="hello")
+            await client.call("push_back")
+            await asyncio.wait_for(got.wait(), 5)
+            assert host.pushes == ["hello"]
+            assert events == [("server_event", {"n": 1})]
+            await client.close()
+            await server.stop()
+
+        asyncio.run(main())
+
+    def test_sync_client_from_main_thread(self):
+        io = EventLoopThread()
+        host = _EchoHost()
+        server = RpcServer(host)
+        port = io.run(server.start())
+        client = SyncRpcClient("127.0.0.1", port, io)
+        assert client.call("echo", value=9) == {"value": 9}
+        client.close()
+        io.run(server.stop())
+        io.stop()
