@@ -18,7 +18,6 @@ Sizes (bytes):
 from __future__ import annotations
 
 import os
-import threading
 
 _JOB_ID_SIZE = 4
 _ACTOR_ID_SIZE = 16
@@ -74,8 +73,6 @@ class BaseID:
 
 class JobID(BaseID):
     SIZE = _JOB_ID_SIZE
-    _counter = 0
-    _lock = threading.Lock()
 
     @classmethod
     def from_int(cls, value: int) -> "JobID":
@@ -163,7 +160,3 @@ class ObjectID(BaseID):
 
     def job_id(self) -> JobID:
         return self.task_id().job_id()
-
-
-# ObjectRef is the user-facing alias (see ray_tpu/_private/object_ref.py for
-# the full ref type carrying owner metadata).

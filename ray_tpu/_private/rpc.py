@@ -82,6 +82,7 @@ class RpcServer:
         self._port = port
         self._server: Optional[asyncio.base_events.Server] = None
         self.connections: set[RpcServerConnection] = set()
+        self._wants_conn_cache: Dict[str, bool] = {}
 
     @property
     def port(self) -> int:
@@ -97,6 +98,11 @@ class RpcServer:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
+            for conn in list(self.connections):
+                try:
+                    conn.writer.close()
+                except Exception:
+                    pass
             await self._server.wait_closed()
 
     async def _handle_conn(self, reader, writer):
@@ -128,7 +134,8 @@ class RpcServer:
     async def _run_oneway(self, conn, method, payload):
         try:
             payload = dict(payload or {})
-            payload["_conn"] = conn
+            if self._wants_conn(method):
+                payload["_conn"] = conn
             await self._host_obj.dispatch(method, payload)
         except Exception:
             import traceback
@@ -153,11 +160,18 @@ class RpcServer:
             pass
 
     def _wants_conn(self, method: str) -> bool:
+        cached = self._wants_conn_cache.get(method)
+        if cached is not None:
+            return cached
         handler = getattr(self._host_obj, f"rpc_{method}", None)
-        if handler is None:
-            return False
-        code = getattr(handler, "__code__", None)
-        return bool(code and "_conn" in code.co_varnames)
+        code = getattr(handler, "__code__", None) if handler is not None else None
+        if code is None:
+            result = False
+        else:
+            nparams = code.co_argcount + code.co_kwonlyargcount
+            result = "_conn" in code.co_varnames[:nparams]
+        self._wants_conn_cache[method] = result
+        return result
 
 
 class RpcClient:
@@ -237,20 +251,37 @@ class RpcClient:
 
         if self._writer is None:
             await self.connect()
+        writer = self._writer
+        if writer is None:
+            raise ConnectionLost(f"connection to {self._label or self.host}:{self.port} lost")
         req_id = next(self._req_ids)
+        frame = _pack(_REQUEST, req_id, method, payload)
         fut = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
-        self._writer.write(_pack(_REQUEST, req_id, method, payload))
-        await self._writer.drain()
-        return await asyncio.wait_for(
-            fut, timeout if timeout is not None else config.rpc_call_timeout_s
-        )
+        try:
+            writer.write(frame)
+            await writer.drain()
+        except (OSError, RuntimeError, AttributeError) as e:
+            self._pending.pop(req_id, None)
+            raise ConnectionLost(str(e)) from e
+        try:
+            return await asyncio.wait_for(
+                fut, timeout if timeout is not None else config.rpc_call_timeout_s
+            )
+        finally:
+            self._pending.pop(req_id, None)
 
     async def oneway(self, method: str, **payload) -> None:
         if self._writer is None:
             await self.connect()
-        self._writer.write(_pack(_ONEWAY, 0, method, payload))
-        await self._writer.drain()
+        writer = self._writer
+        if writer is None:
+            raise ConnectionLost(f"connection to {self._label or self.host}:{self.port} lost")
+        try:
+            writer.write(_pack(_ONEWAY, 0, method, payload))
+            await writer.drain()
+        except (OSError, RuntimeError, AttributeError) as e:
+            raise ConnectionLost(str(e)) from e
 
 
 class EventLoopThread:
@@ -296,13 +327,23 @@ class SyncRpcClient:
         return self._client
 
     def call(self, method: str, timeout: Optional[float] = None, **payload) -> Any:
+        from ray_tpu._private.config import config
+
+        # Outer margin over the inner asyncio timeout so a wedged IO loop
+        # cannot block the caller forever.
+        inner = timeout if timeout is not None else config.rpc_call_timeout_s
         return self._io.run(
             self._client.call(method, timeout=timeout, **payload),
-            timeout=None,
+            timeout=inner + 30.0,
         )
 
     def oneway(self, method: str, **payload) -> None:
-        self._io.run(self._client.oneway(method, **payload))
+        from ray_tpu._private.config import config
+
+        self._io.run(
+            self._client.oneway(method, **payload),
+            timeout=config.rpc_call_timeout_s,
+        )
 
     def close(self):
         try:

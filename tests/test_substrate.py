@@ -75,10 +75,17 @@ class TestSerialization:
         assert out.base is not None
 
     def test_alignment(self):
+        # Frame offsets are 64-aligned relative to the buffer start; absolute
+        # alignment additionally requires an aligned base (the shm store
+        # allocates 64-aligned, heap bytes do not guarantee it).
         arr = np.ones(1000, dtype=np.float64)
         data = serialization.serialize_to_bytes(("pre", arr))
-        out = serialization.deserialize(data)
-        assert out[1].ctypes.data % 64 == 0
+        mv = memoryview(data)
+        frames = serialization.unpack_frames(mv)
+        base = np.frombuffer(data, dtype=np.uint8).ctypes.data
+        for f in frames[1:]:
+            off = np.frombuffer(f, dtype=np.uint8).ctypes.data - base
+            assert off % 64 == 0
 
     def test_closure(self):
         x = 41
