@@ -1,0 +1,556 @@
+"""Shared-memory object store (plasma equivalent).
+
+Equivalent role to the reference's plasma store
+(reference: src/ray/object_manager/plasma/store.h,
+object_lifecycle_manager.h, create_request_queue.h): one store per node,
+living inside the node agent's event loop; clients (driver/workers on the
+same host) mmap the same arena file and read sealed objects zero-copy.
+
+Differences from the reference, chosen for the TPU build:
+- The arena is a plain file in /dev/shm mmap'd MAP_SHARED by name — no
+  fd-passing over a Unix socket (reference: plasma/fling.cc) is needed
+  because clients can open the file themselves.
+- Allocation is a 64-byte-aligned first-fit free list (reference uses a
+  dlmalloc arena, plasma/dlmalloc.cc). 64-byte alignment keeps numpy /
+  jax host-array frames cache-line aligned for fast host->device DMA.
+- Objects that do not fit in the arena fall back to disk files
+  (reference: fallback allocation in plasma/plasma_allocator.cc), and the
+  store spills cold primaries / evicts secondary copies under pressure
+  (reference: eviction_policy.h, local_object_manager.cc).
+
+Client reads stay pinned while any deserialized value still references
+the buffer: `Buffer` implements the PEP 688 buffer protocol, so arrays
+produced by zero-copy deserialization keep the `Buffer` alive and its
+collection releases the pin (reference: PlasmaBuffer in _raylet.pyx).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import mmap
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+_ALIGN = 64
+
+
+class ObjectStoreFull(Exception):
+    pass
+
+
+class ObjectAlreadyExists(Exception):
+    pass
+
+
+def _aligned(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+class ShmArena:
+    """A named, mmap'd shared-memory file that any local process can attach."""
+
+    def __init__(self, path: str, size: int, create: bool):
+        self.path = path
+        self.size = size
+        flags = os.O_RDWR | (os.O_CREAT if create else 0)
+        fd = os.open(path, flags, 0o600)
+        try:
+            if create:
+                os.ftruncate(fd, size)
+            else:
+                self.size = os.fstat(fd).st_size
+            self._mmap = mmap.mmap(fd, self.size, mmap.MAP_SHARED)
+        finally:
+            os.close(fd)
+        self.view = memoryview(self._mmap)
+
+    @classmethod
+    def create(cls, path: str, size: int) -> "ShmArena":
+        return cls(path, size, create=True)
+
+    @classmethod
+    def attach(cls, path: str) -> "ShmArena":
+        return cls(path, 0, create=False)
+
+    def close(self, unlink: bool = False) -> None:
+        try:
+            self.view.release()
+        except Exception:
+            pass
+        try:
+            self._mmap.close()
+        except Exception:
+            pass
+        if unlink:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+class FreeListAllocator:
+    """First-fit free-list allocator with coalescing; offsets 64-aligned."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        # sorted list of (offset, size) free blocks
+        self._free: List[Tuple[int, int]] = [(0, capacity)]
+        self.allocated = 0
+
+    def alloc(self, size: int) -> Optional[int]:
+        size = _aligned(max(size, 1))
+        for i, (off, blk) in enumerate(self._free):
+            if blk >= size:
+                if blk == size:
+                    self._free.pop(i)
+                else:
+                    self._free[i] = (off + size, blk - size)
+                self.allocated += size
+                return off
+        return None
+
+    def free(self, offset: int, size: int) -> None:
+        size = _aligned(max(size, 1))
+        self.allocated -= size
+        # insert keeping order, then coalesce neighbors
+        lo, hi = 0, len(self._free)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self._free[mid][0] < offset:
+                lo = mid + 1
+            else:
+                hi = mid
+        self._free.insert(lo, (offset, size))
+        # coalesce with next
+        if lo + 1 < len(self._free):
+            off, blk = self._free[lo]
+            noff, nblk = self._free[lo + 1]
+            if off + blk == noff:
+                self._free[lo] = (off, blk + nblk)
+                self._free.pop(lo + 1)
+        # coalesce with prev
+        if lo > 0:
+            poff, pblk = self._free[lo - 1]
+            off, blk = self._free[lo]
+            if poff + pblk == off:
+                self._free[lo - 1] = (poff, pblk + blk)
+                self._free.pop(lo)
+
+
+@dataclass
+class _Entry:
+    size: int
+    location: str  # "shm" | "disk"
+    offset: int = 0  # shm only
+    path: str = ""  # disk only
+    sealed: bool = False
+    primary: bool = True
+    created_at: float = field(default_factory=time.monotonic)
+    last_used: float = field(default_factory=time.monotonic)
+    pins: Dict[str, int] = field(default_factory=dict)  # client_id -> count
+
+    @property
+    def pinned(self) -> bool:
+        return any(v > 0 for v in self.pins.values())
+
+
+class StoreCore:
+    """Server-side object store logic; runs inside the node agent's loop.
+
+    Async methods may wait (get blocks until seal); mutation is effectively
+    serialized by the single event loop.
+    """
+
+    def __init__(self, arena_path: str, capacity: int, spill_dir: str):
+        self.arena = ShmArena.create(arena_path, capacity)
+        self.alloc = FreeListAllocator(capacity)
+        self.spill_dir = spill_dir
+        os.makedirs(spill_dir, exist_ok=True)
+        self.objects: Dict[str, _Entry] = {}
+        self._seal_events: Dict[str, asyncio.Event] = {}
+        self._deleted: Set[str] = set()  # freed oids: get() fails fast
+        self.num_spilled = 0
+        self.num_evicted = 0
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def create(self, oid: str, size: int, primary: bool = True) -> Dict[str, Any]:
+        """Reserve space for oid. Returns {"location","offset"|"path"}."""
+        if oid in self.objects:
+            raise ObjectAlreadyExists(oid)
+        self._deleted.discard(oid)
+        if size <= self.arena.size:
+            offset = self.alloc.alloc(size)
+            if offset is None:
+                self._reclaim(size)
+                offset = self.alloc.alloc(size)
+            if offset is not None:
+                self.objects[oid] = _Entry(size=size, location="shm", offset=offset,
+                                           primary=primary)
+                return {"location": "shm", "offset": offset, "size": size}
+        # fallback to disk (reference: plasma fallback allocation)
+        path = os.path.join(self.spill_dir, f"obj-{oid}")
+        with open(path, "wb") as f:
+            f.truncate(size)
+        self.objects[oid] = _Entry(size=size, location="disk", path=path,
+                                   primary=primary)
+        return {"location": "disk", "path": path, "size": size}
+
+    def seal(self, oid: str) -> None:
+        entry = self.objects.get(oid)
+        if entry is None:
+            raise KeyError(f"seal of unknown object {oid}")
+        entry.sealed = True
+        ev = self._seal_events.pop(oid, None)
+        if ev is not None:
+            ev.set()
+
+    def abort(self, oid: str) -> None:
+        """Abort an unsealed create (client died mid-write)."""
+        entry = self.objects.get(oid)
+        if entry is not None and not entry.sealed:
+            self._drop(oid, entry)
+
+    async def get(self, oids: List[str], client_id: str,
+                  wait_timeout: Optional[float] = None) -> List[Optional[Dict[str, Any]]]:
+        """Wait for each oid to be sealed locally; pin and return locations.
+
+        Returns None for objects not local (caller triggers a pull) and
+        {"deleted": True} for freed objects.
+        """
+        deadline = None if wait_timeout is None else time.monotonic() + wait_timeout
+        out: List[Optional[Dict[str, Any]]] = []
+        for oid in oids:
+            if oid in self._deleted:
+                out.append({"deleted": True})
+                continue
+            entry = self.objects.get(oid)
+            if entry is not None and not entry.sealed:
+                ev = self._seal_events.setdefault(oid, asyncio.Event())
+                remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+                try:
+                    await asyncio.wait_for(ev.wait(), remaining)
+                except asyncio.TimeoutError:
+                    out.append(None)
+                    continue
+                entry = self.objects.get(oid)
+            if entry is None:
+                out.append({"deleted": True} if oid in self._deleted else None)
+                continue
+            entry.last_used = time.monotonic()
+            if entry.location == "disk":
+                entry.pins[client_id] = entry.pins.get(client_id, 0) + 1
+                out.append({"location": "disk", "path": entry.path, "size": entry.size})
+            else:
+                entry.pins[client_id] = entry.pins.get(client_id, 0) + 1
+                out.append({"location": "shm", "offset": entry.offset, "size": entry.size})
+        return out
+
+    def contains(self, oid: str) -> bool:
+        if oid in self._deleted:
+            return False
+        e = self.objects.get(oid)
+        return e is not None and e.sealed
+
+    def release(self, oid: str, client_id: str) -> None:
+        entry = self.objects.get(oid)
+        if entry is None:
+            return
+        n = entry.pins.get(client_id, 0)
+        if n <= 1:
+            entry.pins.pop(client_id, None)
+        else:
+            entry.pins[client_id] = n - 1
+
+    def release_client(self, client_id: str) -> None:
+        """Drop all pins held by a disconnected client (worker death)."""
+        for entry in self.objects.values():
+            entry.pins.pop(client_id, None)
+
+    def free(self, oids: List[str]) -> None:
+        """Owner-driven delete. Pinned objects are dropped once unpinned."""
+        for oid in oids:
+            entry = self.objects.get(oid)
+            if entry is None:
+                continue
+            self._deleted.add(oid)
+            if not entry.pinned:
+                self._drop(oid, entry)
+            # else: dropped lazily by _reclaim once pins go away
+
+    def usage(self) -> Dict[str, Any]:
+        return {
+            "capacity": self.alloc.capacity,
+            "allocated": self.alloc.allocated,
+            "num_objects": len(self.objects),
+            "num_spilled": self.num_spilled,
+            "num_evicted": self.num_evicted,
+        }
+
+    # ---- memory pressure -------------------------------------------------
+
+    def _drop(self, oid: str, entry: _Entry) -> None:
+        self.objects.pop(oid, None)
+        # wake any getters blocked on the seal event; they re-check and see
+        # the object is gone (deleted/None) instead of waiting out the timeout
+        ev = self._seal_events.pop(oid, None)
+        if ev is not None:
+            ev.set()
+        if entry.location == "shm":
+            self.alloc.free(entry.offset, entry.size)
+        else:
+            try:
+                os.unlink(entry.path)
+            except OSError:
+                pass
+
+    def _reclaim(self, needed: int) -> None:
+        """Evict/spill until `needed` bytes could plausibly be allocated.
+
+        Order: freed-but-pinned leftovers, secondary copies (LRU), then
+        spill cold primaries to disk (reference: eviction_policy.h +
+        local_object_manager.cc spilling).
+        """
+        # 1. deleted objects whose pins have since been released
+        for oid in [o for o in self._deleted if o in self.objects]:
+            e = self.objects[oid]
+            if not e.pinned:
+                self._drop(oid, e)
+        if self._headroom() >= needed:
+            return
+        # 2. evict unpinned sealed secondary copies, LRU first
+        candidates = sorted(
+            ((oid, e) for oid, e in self.objects.items()
+             if e.location == "shm" and e.sealed and not e.pinned and not e.primary),
+            key=lambda kv: kv[1].last_used,
+        )
+        for oid, e in candidates:
+            self._drop(oid, e)
+            self.num_evicted += 1
+            if self._headroom() >= needed:
+                return
+        # 3. spill unpinned sealed primaries to disk, LRU first
+        candidates = sorted(
+            ((oid, e) for oid, e in self.objects.items()
+             if e.location == "shm" and e.sealed and not e.pinned and e.primary),
+            key=lambda kv: kv[1].last_used,
+        )
+        for oid, e in candidates:
+            self._spill(oid, e)
+            if self._headroom() >= needed:
+                return
+
+    def _headroom(self) -> int:
+        return max((blk for _, blk in self.alloc._free), default=0)
+
+    def _spill(self, oid: str, entry: _Entry) -> None:
+        path = os.path.join(self.spill_dir, f"obj-{oid}")
+        with open(path, "wb") as f:
+            f.write(self.arena.view[entry.offset:entry.offset + entry.size])
+        self.alloc.free(entry.offset, entry.size)
+        entry.location = "disk"
+        entry.path = path
+        entry.offset = 0
+        self.num_spilled += 1
+
+    def close(self, unlink: bool = True) -> None:
+        self.arena.close(unlink=unlink)
+
+
+class Buffer:
+    """A pinned read view; collection of the last view releases the pin.
+
+    Implements the PEP 688 buffer protocol so zero-copy consumers (numpy,
+    pickle5 out-of-band loads) hold a reference to *this* object, not just
+    the underlying mmap — guaranteeing the store cannot recycle the bytes
+    while any deserialized value is alive.
+    """
+
+    def __init__(self, mv: memoryview, on_release: Optional[Callable[[], None]] = None):
+        self._mv = mv
+        self._on_release = on_release
+
+    def __buffer__(self, flags: int) -> memoryview:
+        return self._mv
+
+    def __len__(self) -> int:
+        return self._mv.nbytes
+
+    def __del__(self):
+        cb, self._on_release = self._on_release, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass
+
+
+class _SharedRelease:
+    """Calls `fn` once, after `count` participants have all released."""
+
+    def __init__(self, count: int, fn: Callable[[], None]):
+        self._count = count
+        self._fn = fn
+
+    def __call__(self):
+        self._count -= 1
+        if self._count == 0 and self._fn is not None:
+            fn, self._fn = self._fn, None
+            fn()
+
+
+def deserialize_pinned(data: memoryview, on_release: Optional[Callable[[], None]]) -> Any:
+    """Zero-copy deserialize; the pin is released when the value (all of its
+    out-of-band-backed parts) is garbage collected, or immediately if the
+    value embeds no out-of-band buffers."""
+    from ray_tpu._private import serialization
+
+    frames = serialization.unpack_frames(data)
+    if len(frames) == 1 or on_release is None:
+        import pickle
+
+        value = pickle.loads(frames[0])
+        if on_release is not None:
+            on_release()
+        return value
+    import pickle
+
+    shared = _SharedRelease(len(frames) - 1, on_release)
+    buffers = [Buffer(f, shared) for f in frames[1:]]
+    return pickle.loads(frames[0], buffers=buffers)
+
+
+class PlasmaClient:
+    """Client-side store access: mmap attach + agent RPC for control.
+
+    `rpc` is a SyncRpcClient to the node agent, whose RpcHost exposes
+    store_create/store_seal/store_get/store_release/store_free/
+    store_contains (see node_agent.py).
+    """
+
+    def __init__(self, arena_path: str, rpc, client_id: str):
+        self.arena = ShmArena.attach(arena_path)
+        self.rpc = rpc
+        self.client_id = client_id
+
+    def put_serialized(self, oid: str, frames, total_size: int,
+                       primary: bool = True) -> None:
+        from ray_tpu._private import serialization
+
+        loc = self.rpc.call("store_create", oid=oid, size=total_size, primary=primary)
+        try:
+            if loc["location"] == "shm":
+                out = self.arena.view[loc["offset"]:loc["offset"] + total_size]
+                serialization.pack_into(frames, out)
+            else:
+                buf = bytearray(total_size)
+                serialization.pack_into(frames, memoryview(buf))
+                with open(loc["path"], "r+b") as f:
+                    f.write(buf)
+        except BaseException:
+            self._abort(oid)
+            raise
+        self.rpc.call("store_seal", oid=oid)
+
+    def put_raw(self, oid: str, data: bytes, primary: bool = True) -> None:
+        loc = self.rpc.call("store_create", oid=oid, size=len(data), primary=primary)
+        try:
+            if loc["location"] == "shm":
+                self.arena.view[loc["offset"]:loc["offset"] + len(data)] = data
+            else:
+                with open(loc["path"], "r+b") as f:
+                    f.write(data)
+        except BaseException:
+            self._abort(oid)
+            raise
+        self.rpc.call("store_seal", oid=oid)
+
+    def _abort(self, oid: str) -> None:
+        try:
+            self.rpc.call("store_abort", oid=oid)
+        except Exception:
+            pass
+
+    def get_locations(self, oids: List[str],
+                      timeout: Optional[float] = None) -> Dict[str, Dict[str, Any]]:
+        """Resolve (and pin) locations for oids, waiting for seals.
+
+        Missing/timed-out objects are absent from the result; freed objects
+        map to {"deleted": True}. Each *found* object is pinned exactly once
+        even across retries.
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        found: Dict[str, Dict[str, Any]] = {}
+        pending = list(oids)
+        while pending:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            round_wait = 10.0 if remaining is None else min(10.0, remaining)
+            locs = self.rpc.call(
+                "store_get", oids=pending, client_id=self.client_id,
+                wait_timeout=round_wait,
+                timeout=round_wait * max(1, len(pending)) + 30.0,
+            )
+            still = []
+            for oid, loc in zip(pending, locs):
+                if loc is None:
+                    still.append(oid)
+                else:
+                    found[oid] = loc
+            pending = still
+            if pending and deadline is not None and time.monotonic() >= deadline:
+                break
+        return found
+
+    def get_values(self, oids: List[str], timeout: Optional[float] = None) -> List[Any]:
+        """Fetch + deserialize; raises KeyError on timeout/missing/freed."""
+        found = self.get_locations(oids, timeout=timeout)
+        missing = [oid for oid in oids
+                   if found.get(oid) is None or found[oid].get("deleted")]
+        if missing:
+            # release pins taken on the objects we did find before bailing
+            for oid, loc in found.items():
+                if not loc.get("deleted"):
+                    try:
+                        self.rpc.oneway("store_release", oid=oid,
+                                        client_id=self.client_id)
+                    except Exception:
+                        pass
+            loc = found.get(missing[0])
+            freed = loc is not None and loc.get("deleted")
+            raise KeyError(f"object {missing[0]} not available"
+                           + (" (freed)" if freed else ""))
+        return [self._load(oid, found[oid]) for oid in oids]
+
+    def _load(self, oid: str, loc: Dict[str, Any]) -> Any:
+        if loc["location"] == "shm":
+            mv = self.arena.view[loc["offset"]:loc["offset"] + loc["size"]]
+            release = self._make_release(oid)
+            return deserialize_pinned(mv, release)
+        # disk object: mmap the file for zero-copy reads
+        with open(loc["path"], "rb") as f:
+            mapped = mmap.mmap(f.fileno(), loc["size"], mmap.MAP_SHARED, mmap.PROT_READ)
+        mv = memoryview(mapped)
+        release = self._make_release(oid)
+        return deserialize_pinned(mv, release)
+
+    def _make_release(self, oid: str):
+        rpc, client_id = self.rpc, self.client_id
+
+        def release():
+            try:
+                rpc.oneway("store_release", oid=oid, client_id=client_id)
+            except Exception:
+                pass
+
+        return release
+
+    def contains(self, oid: str) -> bool:
+        return bool(self.rpc.call("store_contains", oid=oid))
+
+    def free(self, oids: List[str]) -> None:
+        self.rpc.call("store_free", oids=oids)
+
+    def close(self):
+        self.arena.close(unlink=False)
