@@ -1,0 +1,112 @@
+"""Local + cluster scheduling policy.
+
+Equivalent of the reference's two-level scheduler
+(reference: src/ray/raylet/scheduling/cluster_resource_scheduler.h,
+local_task_manager.h, policy/hybrid_scheduling_policy.h): the cluster
+policy picks a node for a lease request (prefer-local below a
+utilization threshold, then top-k random among the best-scoring nodes);
+the local scheduler grants leases against the node's available resources
+in FIFO-with-resources order.
+
+TPU note: TPU chips are ordinary resources here; slice gang placement is
+layered on via placement groups whose bundles carry TPU resources, so
+multi-host slices are all-or-nothing (reference:
+python/ray/_private/accelerators/tpu.py TPU-{type}-head resources).
+"""
+
+from __future__ import annotations
+
+import random
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from ray_tpu._private.resources import NodeResources, ResourceSet
+
+
+class LocalScheduler:
+    """FIFO-with-resources lease granting against one node's resources."""
+
+    def __init__(self, resources: NodeResources):
+        self.resources = resources
+        # queue of (token, demand); granted via callback to preserve FIFO
+        self._queue: Deque[Tuple[object, ResourceSet]] = deque()
+
+    def try_acquire(self, demand: ResourceSet) -> bool:
+        """Immediately acquire if available AND nothing older is waiting."""
+        if self._queue:
+            return False
+        return self.resources.acquire(demand)
+
+    def enqueue(self, token: object, demand: ResourceSet) -> None:
+        self._queue.append((token, demand))
+
+    def cancel(self, token: object) -> Tuple[bool, List[object]]:
+        """Remove a queued request. Returns (found, newly-grantable tokens) —
+        removing a head-of-line blocker can unblock the queue."""
+        for i, (t, _) in enumerate(self._queue):
+            if t == token:
+                del self._queue[i]
+                return True, self.drain()
+        return False, []
+
+    def release(self, demand: ResourceSet) -> List[object]:
+        """Release resources; returns tokens of newly grantable requests."""
+        self.resources.release(demand)
+        return self.drain()
+
+    def drain(self) -> List[object]:
+        """Grant queued requests in FIFO order while they fit."""
+        granted = []
+        while self._queue:
+            token, demand = self._queue[0]
+            if not self.resources.acquire(demand):
+                break
+            self._queue.popleft()
+            granted.append(token)
+        return granted
+
+    @property
+    def num_queued(self) -> int:
+        return len(self._queue)
+
+
+def pick_node(
+    cluster: Dict[str, NodeResources],
+    demand: ResourceSet,
+    local_node_id: str,
+    spread_threshold: float = 0.5,
+    top_k_fraction: float = 0.2,
+    top_k_absolute: int = 1,
+    rng: Optional[random.Random] = None,
+) -> Optional[str]:
+    """Hybrid policy: choose the node to send a lease request to.
+
+    1. Local node if it has the resources available and is under the
+       spread threshold.
+    2. Otherwise a random pick among the top-k least-utilized nodes with
+       the resources available.
+    3. Otherwise any node where the demand is *feasible* (total resources
+       cover it) — the request queues there.
+    4. None if infeasible everywhere (caller surfaces a scheduling error).
+    """
+    rng = rng or random
+    local = cluster.get(local_node_id)
+    if (local is not None and local.can_fit(demand)
+            and local.utilization() < spread_threshold):
+        return local_node_id
+
+    available = [(nid, nr) for nid, nr in cluster.items() if nr.can_fit(demand)]
+    if available:
+        available.sort(key=lambda kv: kv[1].utilization())
+        # absolute floor is configurable (reference: ray_config_def.h
+        # scheduler_top_k_fraction / scheduler_top_k_absolute)
+        k = min(len(available),
+                max(top_k_absolute, int(len(available) * top_k_fraction)))
+        return rng.choice(available[:k])[0]
+
+    feasible = [nid for nid, nr in cluster.items() if nr.is_feasible(demand)]
+    if feasible:
+        # queue on the least loaded feasible node
+        feasible.sort(key=lambda nid: cluster[nid].utilization())
+        return feasible[0]
+    return None

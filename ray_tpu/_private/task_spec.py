@@ -1,0 +1,138 @@
+"""Typed task specification and wire argument encoding.
+
+Equivalent of the reference's TaskSpecification
+(reference: src/ray/common/task/task_spec.h, protobuf common.proto
+TaskSpec): everything a node agent / worker needs to schedule and run a
+task, as a msgpack-able dict. Args follow the reference's inline-vs-ref
+split (reference: ray_config_def.h:206 max_direct_call_object_size):
+small serialized values travel inside the spec; large ones are put into
+the object store and travel as (object_id, owner_address) references.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.resources import ResourceSet
+
+NORMAL_TASK = 0
+ACTOR_CREATION_TASK = 1
+ACTOR_TASK = 2
+
+
+@dataclass
+class WireArg:
+    """One positional/keyword argument on the wire."""
+
+    # exactly one of `value` (serialized bytes, inline) or `object_id` is set
+    value: Optional[bytes] = None
+    object_id: Optional[str] = None  # hex
+    owner_addr: Optional[Tuple[str, int]] = None  # (host, port) of owner's RPC
+    kw: Optional[str] = None  # keyword name; None for positional
+
+    def to_wire(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self.value is not None:
+            d["v"] = self.value
+        elif self.object_id is None:
+            raise ValueError("WireArg needs exactly one of value/object_id")
+        else:
+            d["oid"] = self.object_id
+            if self.owner_addr:
+                d["owner"] = list(self.owner_addr)
+        if self.kw:
+            d["kw"] = self.kw
+        return d
+
+    @classmethod
+    def from_wire(cls, d: Dict[str, Any]) -> "WireArg":
+        owner = d.get("owner")
+        return cls(
+            value=d.get("v"),
+            object_id=d.get("oid"),
+            owner_addr=tuple(owner) if owner else None,
+            kw=d.get("kw"),
+        )
+
+
+@dataclass
+class TaskSpec:
+    task_id: str  # hex
+    job_id: str
+    kind: int = NORMAL_TASK
+    function_id: str = ""  # hex key into the head's function table
+    args: List[WireArg] = field(default_factory=list)
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_retries: int = 3
+    # actor fields
+    actor_id: str = ""
+    method_name: str = ""
+    seqno: int = 0  # per-(caller, actor) ordered delivery
+    caller_id: str = ""  # worker id of the submitter, for seqno namespacing
+    max_restarts: int = 0  # actor creation only
+    max_concurrency: int = 1  # actor creation only
+    # scheduling hints
+    name: str = ""
+    owner_addr: Optional[Tuple[str, int]] = None  # owner RPC addr for returns
+    placement_group_id: str = ""
+    bundle_index: int = -1
+    runtime_env: Dict[str, Any] = field(default_factory=dict)
+
+    def resource_set(self) -> ResourceSet:
+        return ResourceSet(self.resources)
+
+    def scheduling_class(self) -> tuple:
+        """Tasks with the same shape share worker leases (reference:
+        SchedulingClassDescriptor in task_spec.h)."""
+        return (ResourceSet(self.resources).key(), self.kind,
+                self.placement_group_id, self.bundle_index)
+
+    def to_wire(self) -> Dict[str, Any]:
+        return {
+            "tid": self.task_id,
+            "jid": self.job_id,
+            "kind": self.kind,
+            "fid": self.function_id,
+            "args": [a.to_wire() for a in self.args],
+            "nret": self.num_returns,
+            "res": self.resources,
+            "retries": self.max_retries,
+            "aid": self.actor_id,
+            "method": self.method_name,
+            "seq": self.seqno,
+            "caller": self.caller_id,
+            "max_restarts": self.max_restarts,
+            "max_conc": self.max_concurrency,
+            "name": self.name,
+            "owner": list(self.owner_addr) if self.owner_addr else None,
+            "pg": self.placement_group_id,
+            "bundle": self.bundle_index,
+            "renv": self.runtime_env,
+        }
+
+    @classmethod
+    def from_wire(cls, d: Dict[str, Any]) -> "TaskSpec":
+        owner = d.get("owner")
+        return cls(
+            task_id=d["tid"],
+            job_id=d["jid"],
+            kind=d.get("kind", NORMAL_TASK),
+            function_id=d.get("fid", ""),
+            args=[WireArg.from_wire(a) for a in d.get("args", [])],
+            num_returns=d.get("nret", 1),
+            resources=d.get("res", {}),
+            max_retries=d.get("retries", 3),
+            actor_id=d.get("aid", ""),
+            method_name=d.get("method", ""),
+            seqno=d.get("seq", 0),
+            caller_id=d.get("caller", ""),
+            max_restarts=d.get("max_restarts", 0),
+            max_concurrency=d.get("max_conc", 1),
+            name=d.get("name", ""),
+            owner_addr=tuple(owner) if owner else None,
+            placement_group_id=d.get("pg", ""),
+            bundle_index=d.get("bundle", -1),
+            runtime_env=d.get("renv", {}),
+        )

@@ -1,0 +1,157 @@
+"""Resource sets, task spec wire format, local/cluster scheduling."""
+
+import random
+
+import pytest
+
+from ray_tpu._private.resources import NodeResources, ResourceSet
+from ray_tpu._private.scheduler import LocalScheduler, pick_node
+from ray_tpu._private.task_spec import TaskSpec, WireArg
+
+
+class TestResourceSet:
+    def test_fixed_point_exact(self):
+        r = ResourceSet({"CPU": 0.1})
+        total = ResourceSet({})
+        for _ in range(10):
+            total = total.add(r)
+        assert total == ResourceSet({"CPU": 1.0})
+        for _ in range(10):
+            total = total.subtract(r)
+        assert total.is_empty()
+
+    def test_fits_and_underflow(self):
+        avail = ResourceSet({"CPU": 4, "TPU": 8})
+        assert avail.fits(ResourceSet({"CPU": 2, "TPU": 8}))
+        assert not avail.fits(ResourceSet({"CPU": 5}))
+        assert not avail.fits(ResourceSet({"custom": 1}))
+        with pytest.raises(ValueError):
+            avail.subtract(ResourceSet({"GPU": 1}))
+
+    def test_node_acquire_release(self):
+        nr = NodeResources(ResourceSet({"CPU": 2, "TPU": 4}))
+        d = ResourceSet({"CPU": 1, "TPU": 4})
+        assert nr.acquire(d)
+        assert not nr.acquire(d)  # TPUs exhausted
+        assert nr.utilization() == 1.0
+        nr.release(d)
+        assert nr.available == nr.total
+        # double release clamps at total
+        nr.release(d)
+        assert nr.available == nr.total
+
+    def test_feasible_vs_available(self):
+        nr = NodeResources(ResourceSet({"TPU": 4}))
+        nr.acquire(ResourceSet({"TPU": 4}))
+        assert nr.is_feasible(ResourceSet({"TPU": 4}))
+        assert not nr.can_fit(ResourceSet({"TPU": 4}))
+        assert not nr.is_feasible(ResourceSet({"TPU": 8}))
+
+
+class TestTaskSpec:
+    def test_wire_roundtrip(self):
+        spec = TaskSpec(
+            task_id="ab" * 12, job_id="01020304", function_id="ff" * 8,
+            args=[WireArg(value=b"inline"),
+                  WireArg(object_id="cd" * 14, owner_addr=("127.0.0.1", 9000)),
+                  WireArg(value=b"kwv", kw="key")],
+            num_returns=2, resources={"CPU": 1, "TPU": 0.5},
+            actor_id="ee" * 8, method_name="step", seqno=7,
+            owner_addr=("10.0.0.1", 1234),
+        )
+        import msgpack
+        wire = msgpack.unpackb(msgpack.packb(spec.to_wire(), use_bin_type=True),
+                               raw=False)
+        back = TaskSpec.from_wire(wire)
+        assert back.task_id == spec.task_id
+        assert back.args[0].value == b"inline"
+        assert back.args[1].object_id == "cd" * 14
+        assert back.args[1].owner_addr == ("127.0.0.1", 9000)
+        assert back.args[2].kw == "key"
+        assert back.resources == {"CPU": 1, "TPU": 0.5}
+        assert back.owner_addr == ("10.0.0.1", 1234)
+        assert back.seqno == 7
+
+    def test_scheduling_class_groups_same_shape(self):
+        a = TaskSpec(task_id="a", job_id="j", resources={"CPU": 1})
+        b = TaskSpec(task_id="b", job_id="j", resources={"CPU": 1.0})
+        c = TaskSpec(task_id="c", job_id="j", resources={"CPU": 2})
+        assert a.scheduling_class() == b.scheduling_class()
+        assert a.scheduling_class() != c.scheduling_class()
+
+
+class TestLocalScheduler:
+    def test_fifo_with_resources(self):
+        s = LocalScheduler(NodeResources(ResourceSet({"CPU": 2})))
+        one = ResourceSet({"CPU": 1})
+        assert s.try_acquire(one)
+        assert s.try_acquire(one)
+        assert not s.try_acquire(one)
+        s.enqueue("t3", one)
+        s.enqueue("t4", one)
+        assert s.release(one) == ["t3"]
+        assert s.release(one) == ["t4"]
+
+    def test_fifo_order_preserved_under_mixed_sizes(self):
+        s = LocalScheduler(NodeResources(ResourceSet({"CPU": 4})))
+        big, small = ResourceSet({"CPU": 4}), ResourceSet({"CPU": 1})
+        assert s.try_acquire(big)
+        s.enqueue("big2", big)
+        s.enqueue("small", small)
+        # small fits now but must wait behind big2 (FIFO head-of-line)
+        assert s.try_acquire(small) is False
+        granted = s.release(big)
+        assert granted == ["big2"]
+
+    def test_cancel(self):
+        s = LocalScheduler(NodeResources(ResourceSet({"CPU": 1})))
+        assert s.try_acquire(ResourceSet({"CPU": 1}))
+        s.enqueue("x" * 9, ResourceSet({"CPU": 1}))
+        found, granted = s.cancel("xxxxxxxx" + "x")  # equal, not identical
+        assert found and granted == []
+        assert s.release(ResourceSet({"CPU": 1})) == []
+
+    def test_cancel_head_of_line_unblocks(self):
+        s = LocalScheduler(NodeResources(ResourceSet({"CPU": 2})))
+        assert s.try_acquire(ResourceSet({"CPU": 1}))
+        s.enqueue("big", ResourceSet({"CPU": 2}))
+        s.enqueue("small", ResourceSet({"CPU": 1}))
+        found, granted = s.cancel("big")
+        assert found and granted == ["small"]
+
+
+class TestHybridPolicy:
+    def _cluster(self):
+        c = {}
+        for nid, cpus in [("n1", 4), ("n2", 4), ("n3", 4)]:
+            c[nid] = NodeResources(ResourceSet({"CPU": cpus}))
+        return c
+
+    def test_prefers_local_when_underloaded(self):
+        c = self._cluster()
+        assert pick_node(c, ResourceSet({"CPU": 1}), "n2") == "n2"
+
+    def test_spreads_when_local_hot(self):
+        c = self._cluster()
+        c["n1"].acquire(ResourceSet({"CPU": 3}))  # 75% util > 0.5 threshold
+        rng = random.Random(0)
+        picks = {pick_node(c, ResourceSet({"CPU": 1}), "n1", rng=rng)
+                 for _ in range(20)}
+        assert "n1" not in picks
+        assert picks <= {"n2", "n3"}
+
+    def test_queues_on_feasible_when_all_busy(self):
+        c = self._cluster()
+        for nr in c.values():
+            nr.acquire(ResourceSet({"CPU": 4}))
+        pick = pick_node(c, ResourceSet({"CPU": 2}), "n1")
+        assert pick in c
+
+    def test_infeasible_returns_none(self):
+        c = self._cluster()
+        assert pick_node(c, ResourceSet({"TPU": 8}), "n1") is None
+
+    def test_tpu_demand_targets_tpu_node(self):
+        c = self._cluster()
+        c["tpu-node"] = NodeResources(ResourceSet({"CPU": 1, "TPU": 8}))
+        assert pick_node(c, ResourceSet({"TPU": 4}), "n1") == "tpu-node"
