@@ -1,0 +1,28 @@
+"""ray_tpu: a TPU-native distributed framework with Ray's capabilities.
+
+Core surface (reference: python/ray/__init__.py):
+    init, shutdown, remote, get, put, wait, kill, get_actor,
+    cluster_resources, available_resources, nodes, is_initialized,
+    ObjectRef, ActorHandle, exceptions.
+"""
+
+from ray_tpu._private.errors import (ActorDiedError, ActorUnavailableError,
+                                     GetTimeoutError, ObjectFreedError,
+                                     ObjectLostError, RayError, RayTaskError,
+                                     RayWorkerError, SchedulingError)
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu.api import (ActorClass, ActorHandle, RemoteFunction,
+                         available_resources, cluster_resources, get,
+                         get_actor, init, is_initialized, kill, nodes, put,
+                         remote, shutdown, wait)
+
+__version__ = "0.2.0"
+
+__all__ = [
+    "init", "shutdown", "remote", "get", "put", "wait", "kill", "get_actor",
+    "cluster_resources", "available_resources", "nodes", "is_initialized",
+    "ObjectRef", "ActorHandle", "ActorClass", "RemoteFunction",
+    "RayError", "RayTaskError", "RayWorkerError", "ActorDiedError",
+    "ActorUnavailableError", "ObjectLostError", "ObjectFreedError",
+    "GetTimeoutError", "SchedulingError", "__version__",
+]

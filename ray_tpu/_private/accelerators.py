@@ -1,0 +1,60 @@
+"""Accelerator detection: TPU chips/slices as first-class resources.
+
+Equivalent of the reference's accelerator plugin layer
+(reference: python/ray/_private/accelerators/accelerator.py:5 ABC;
+tpu.py:75 TPUAcceleratorManager — /dev/accel* detection :110,
+TPU_VISIBLE_CHIPS :30, GCE/GKE metadata :52, pod-slice custom resources
+TPU-{type}-head and slice-name resources :335-398).
+
+Detection is cheap (no jax import): device files + env vars + GCE
+metadata when present.  A node on a pod slice additionally advertises
+  - "TPU-<accel_type>-head": 1   on worker 0 of the slice (gang anchor)
+  - "TPU-<slice_name>": 4        so a placement group can target a slice
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Optional
+
+TPU_RESOURCE = "TPU"
+
+
+def num_tpu_chips() -> int:
+    env = os.environ.get("TPU_VISIBLE_CHIPS")
+    if env is not None:
+        return 0 if env in ("", "none") else len(env.split(","))
+    # PCI accel device files (reference: tpu.py:110 _glob_tpu_acclerator_devices)
+    devices = glob.glob("/dev/accel*") or glob.glob("/dev/vfio/*")
+    return len(devices)
+
+
+def tpu_metadata(key: str) -> Optional[str]:
+    """GCE metadata lookup; returns None off-GCP (zero egress tolerated)."""
+    env_map = {
+        "accelerator-type": "TPU_ACCELERATOR_TYPE",
+        "agent-worker-number": "TPU_WORKER_ID",
+        "instance-id": "TPU_NAME",
+    }
+    env = env_map.get(key)
+    if env and os.environ.get(env) is not None:
+        return os.environ.get(env)
+    return None
+
+
+def detect_accelerators() -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    chips = num_tpu_chips()
+    if chips <= 0:
+        return out
+    out[TPU_RESOURCE] = float(chips)
+    accel_type = tpu_metadata("accelerator-type")  # e.g. "v5e-256"
+    worker_id = tpu_metadata("agent-worker-number")
+    slice_name = tpu_metadata("instance-id")
+    if accel_type:
+        if worker_id == "0":
+            out[f"TPU-{accel_type}-head"] = 1.0
+    if slice_name:
+        out[f"TPU-{slice_name}"] = float(chips)
+    return out

@@ -29,6 +29,7 @@ _def("worker_lease_timeout_ms", 30_000)
 _def("worker_pool_prestart_workers", 0)
 _def("worker_idle_timeout_ms", 60_000)
 _def("scheduler_top_k_fraction", 0.2)  # hybrid policy: top-k random among best
+_def("scheduler_top_k_absolute", 5)    # ref: ray_config_def.h scheduler_top_k_absolute
 _def("scheduler_spread_threshold", 0.5)
 _def("task_retry_delay_ms", 100)
 _def("actor_creation_retries", 3)

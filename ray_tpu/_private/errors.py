@@ -1,0 +1,61 @@
+"""User-facing exception taxonomy.
+
+Equivalent of the reference's exception set
+(reference: python/ray/exceptions.py — RayError, RayTaskError,
+RayActorError, WorkerCrashedError, ObjectLostError, ObjectFreedError,
+GetTimeoutError).
+"""
+
+from __future__ import annotations
+
+
+class RayError(Exception):
+    """Base for all framework errors."""
+
+
+class RayTaskError(RayError):
+    """A task/actor method raised; carries the remote traceback.
+
+    Like the reference (python/ray/exceptions.py RayTaskError.as_instanceof_cause),
+    the original exception is chained as `cause` when it was picklable.
+    """
+
+    def __init__(self, function_name: str, traceback_str: str,
+                 cause: BaseException | None = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(f"{function_name} failed:\n{traceback_str}")
+
+    def __reduce__(self):
+        # default exception pickling would replay __init__ with the joined
+        # message as the only argument; rebuild from the real fields
+        return (type(self), (self.function_name, self.traceback_str, self.cause))
+
+
+class RayWorkerError(RayError):
+    """The worker process executing the task died."""
+
+
+class ActorDiedError(RayError):
+    """The actor is dead (creation failed, killed, or out of restarts)."""
+
+
+class ActorUnavailableError(RayError):
+    """The actor is temporarily unreachable (restarting)."""
+
+
+class ObjectLostError(RayError):
+    """The object's value was lost (e.g. the node holding it died)."""
+
+
+class ObjectFreedError(RayError):
+    """The object was freed by its owner; the value is permanently gone."""
+
+
+class GetTimeoutError(RayError, TimeoutError):
+    """ray_tpu.get(..., timeout=...) expired."""
+
+
+class SchedulingError(RayError):
+    """The task's resource demand can never be satisfied by the cluster."""

@@ -1,0 +1,442 @@
+"""Head service: the cluster control plane (GCS equivalent).
+
+Equivalent role to the reference's GCS server
+(reference: src/ray/gcs/gcs_server/gcs_server.h:78 — GcsNodeManager,
+GcsActorManager, GcsKvManager, GcsHealthCheckManager, function table via
+internal KV).  One process per cluster, all state in memory (the
+reference's InMemoryStoreClient mode; Redis persistence is a later
+layer).
+
+Services, all over the msgpack RPC plane (rpc.py):
+  - node table + resource view aggregation (agents heartbeat; the reply
+    carries the cluster resource snapshot so agents can make hybrid
+    scheduling/spillback decisions without a second round trip —
+    equivalent of the reference's ray_syncer resource broadcast,
+    src/ray/common/ray_syncer/ray_syncer.h:88)
+  - internal KV (function table lives under "fn:" keys; reference:
+    gcs_service.proto:522 InternalKVGcsService)
+  - actor directory + lifecycle: creation scheduling, ALIVE publication,
+    restart-on-death with max_restarts (reference:
+    src/ray/gcs/gcs_server/gcs_actor_manager.h, gcs_actor_scheduler.h)
+  - named actors (get_actor), job registration
+  - health: connection-drop + heartbeat-age node failure detection
+    (reference: gcs_health_check_manager.h:39)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import config
+from ray_tpu._private.ids import JobID
+from ray_tpu._private.resources import NodeResources, ResourceSet
+from ray_tpu._private.rpc import RpcClient, RpcHost, RpcServer, RpcError
+from ray_tpu._private.scheduler import pick_node
+from ray_tpu._private.task_spec import TaskSpec
+
+# Actor states (reference: rpc::ActorTableData::ActorState)
+PENDING, ALIVE, RESTARTING, DEAD = "PENDING", "ALIVE", "RESTARTING", "DEAD"
+
+
+class _ActorEntry:
+    __slots__ = ("actor_id", "spec_wire", "state", "node_id", "worker_id",
+                 "addr", "instance", "restarts_left", "name", "waiters",
+                 "death_cause")
+
+    def __init__(self, actor_id: str, spec_wire: Dict[str, Any], name: str,
+                 max_restarts: int):
+        self.actor_id = actor_id
+        self.spec_wire = spec_wire
+        self.state = PENDING
+        self.node_id: str = ""
+        self.worker_id: str = ""
+        self.addr: Optional[Tuple[str, int]] = None
+        self.instance = 0  # bumped on every (re)start
+        self.restarts_left = max_restarts  # -1 = infinite
+        self.name = name
+        self.waiters: List[asyncio.Event] = []
+        self.death_cause = ""
+
+    def info(self) -> Dict[str, Any]:
+        return {
+            "actor_id": self.actor_id,
+            "state": self.state,
+            "addr": list(self.addr) if self.addr else None,
+            "worker_id": self.worker_id,
+            "node_id": self.node_id,
+            "instance": self.instance,
+            "name": self.name,
+            "death_cause": self.death_cause,
+        }
+
+    def wake(self):
+        for ev in self.waiters:
+            ev.set()
+        self.waiters.clear()
+
+
+class _NodeEntry:
+    __slots__ = ("node_id", "host", "port", "arena_path", "resources",
+                 "last_heartbeat", "client", "is_head_node")
+
+    def __init__(self, node_id: str, host: str, port: int, arena_path: str,
+                 resources: NodeResources, is_head_node: bool):
+        self.node_id = node_id
+        self.host = host
+        self.port = port
+        self.arena_path = arena_path
+        self.resources = resources
+        self.last_heartbeat = time.monotonic()
+        self.client: Optional[RpcClient] = None
+        self.is_head_node = is_head_node
+
+    def table_entry(self) -> Dict[str, Any]:
+        return {
+            "node_id": self.node_id,
+            "addr": [self.host, self.port],
+            "arena_path": self.arena_path,
+            "resources": self.resources.to_dict(),
+            "is_head_node": self.is_head_node,
+        }
+
+
+class HeadService(RpcHost):
+    def __init__(self):
+        self.nodes: Dict[str, _NodeEntry] = {}
+        self.kv: Dict[str, bytes] = {}
+        self.actors: Dict[str, _ActorEntry] = {}
+        self.named_actors: Dict[str, str] = {}  # name -> actor_id
+        self._job_counter = itertools.count(1)
+        self._server: Optional[RpcServer] = None
+        self._health_task: Optional[asyncio.Task] = None
+        self._node_conns: Dict[Any, str] = {}  # conn -> node_id
+        self._cluster_version = 0  # bumped on membership change
+        self._shutdown = asyncio.Event()
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._server = RpcServer(self, host, port)
+        p = await self._server.start()
+        self._health_task = asyncio.ensure_future(self._health_loop())
+        return p
+
+    async def stop(self):
+        if self._health_task:
+            self._health_task.cancel()
+        for n in self.nodes.values():
+            if n.client is not None:
+                await n.client.close()
+        if self._server:
+            await self._server.stop()
+        self._shutdown.set()
+
+    async def wait_for_shutdown(self):
+        await self._shutdown.wait()
+
+    # ---- node table --------------------------------------------------------
+
+    async def rpc_register_node(self, node_id: str, host: str, port: int,
+                                arena_path: str, resources: Dict[str, float],
+                                is_head_node: bool = False, _conn=None):
+        entry = _NodeEntry(node_id, host, port, arena_path,
+                           NodeResources(ResourceSet(resources)), is_head_node)
+        self.nodes[node_id] = entry
+        if _conn is not None:
+            self._node_conns[_conn] = node_id
+        self._cluster_version += 1
+        return {"ok": True, "cluster": self._cluster_view()}
+
+    async def rpc_heartbeat(self, node_id: str, available: Dict[str, float]):
+        entry = self.nodes.get(node_id)
+        if entry is None:
+            return {"unknown_node": True}
+        entry.last_heartbeat = time.monotonic()
+        entry.resources.available = ResourceSet(available)
+        return {"cluster": self._cluster_view(), "version": self._cluster_version}
+
+    async def rpc_node_table(self):
+        return {nid: n.table_entry() for nid, n in self.nodes.items()}
+
+    async def rpc_drain_node(self, node_id: str):
+        """Graceful removal (reference: node_manager.proto DrainRaylet)."""
+        await self._on_node_dead(node_id, "drained")
+        return {"ok": True}
+
+    def _cluster_view(self) -> Dict[str, Any]:
+        return {
+            nid: {"addr": [n.host, n.port], "res": n.resources.to_dict()}
+            for nid, n in self.nodes.items()
+        }
+
+    def on_peer_disconnect(self, conn) -> None:
+        node_id = self._node_conns.pop(conn, None)
+        if node_id is not None and node_id in self.nodes:
+            asyncio.ensure_future(self._on_node_dead(node_id, "connection lost"))
+
+    async def _health_loop(self):
+        period = config.gcs_health_check_period_ms / 1000.0
+        threshold = config.gcs_health_check_failure_threshold * period
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for nid in list(self.nodes):
+                n = self.nodes.get(nid)
+                if n is not None and now - n.last_heartbeat > threshold:
+                    await self._on_node_dead(nid, "heartbeat timeout")
+
+    async def _on_node_dead(self, node_id: str, reason: str):
+        entry = self.nodes.pop(node_id, None)
+        if entry is None:
+            return
+        self._cluster_version += 1
+        if entry.client is not None:
+            await entry.client.close()
+        # restart or fail every actor that lived on that node
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state in (ALIVE, PENDING, RESTARTING):
+                await self._on_actor_worker_lost(
+                    actor, f"node {node_id[:8]} died: {reason}")
+
+    # ---- internal KV (function table rides on this) ------------------------
+
+    async def rpc_kv_put(self, key: str, value: bytes, overwrite: bool = True):
+        if not overwrite and key in self.kv:
+            return {"added": False}
+        self.kv[key] = value
+        return {"added": True}
+
+    async def rpc_kv_get(self, key: str):
+        return {"value": self.kv.get(key)}
+
+    async def rpc_kv_del(self, key: str):
+        return {"deleted": self.kv.pop(key, None) is not None}
+
+    async def rpc_kv_keys(self, prefix: str = ""):
+        return {"keys": [k for k in self.kv if k.startswith(prefix)]}
+
+    # ---- jobs --------------------------------------------------------------
+
+    async def rpc_register_job(self, driver_addr: Optional[List] = None):
+        jid = JobID.from_int(next(self._job_counter))
+        return {"job_id": jid.hex()}
+
+    # ---- actor manager -----------------------------------------------------
+
+    async def rpc_create_actor(self, spec: Dict[str, Any], name: str = ""):
+        ts = TaskSpec.from_wire(spec)
+        if name:
+            if name in self.named_actors:
+                raise RpcError(f"actor name {name!r} already taken")
+            self.named_actors[name] = ts.actor_id
+        entry = _ActorEntry(ts.actor_id, spec, name, ts.max_restarts)
+        self.actors[ts.actor_id] = entry
+        asyncio.ensure_future(self._schedule_actor(entry))
+        return {"actor_id": ts.actor_id}
+
+    async def rpc_get_actor_info(self, actor_id: str, wait: bool = False,
+                                 known_instance: int = -1):
+        """Resolve an actor's address; with wait=True, long-poll until the
+        actor leaves PENDING/RESTARTING (or is a newer instance than the
+        caller already knows about)."""
+        entry = self.actors.get(actor_id)
+        if entry is None:
+            return {"state": DEAD, "death_cause": "no such actor"}
+        deadline = time.monotonic() + config.pubsub_poll_timeout_ms / 1000.0
+        while wait and time.monotonic() < deadline:
+            if entry.state == DEAD:
+                break
+            if entry.state == ALIVE and entry.instance > known_instance:
+                break
+            ev = asyncio.Event()
+            entry.waiters.append(ev)
+            try:
+                await asyncio.wait_for(ev.wait(), deadline - time.monotonic())
+            except asyncio.TimeoutError:
+                break
+        return entry.info()
+
+    async def rpc_get_named_actor(self, name: str):
+        aid = self.named_actors.get(name)
+        if aid is None:
+            return {"found": False}
+        return {"found": True, "actor_id": aid}
+
+    async def rpc_list_actors(self):
+        return {"actors": [a.info() for a in self.actors.values()]}
+
+    async def rpc_kill_actor(self, actor_id: str, no_restart: bool = True):
+        entry = self.actors.get(actor_id)
+        if entry is None:
+            return {"ok": False}
+        if no_restart:
+            entry.restarts_left = 0
+        if entry.state == ALIVE and entry.addr is not None:
+            client = RpcClient(entry.addr[0], entry.addr[1], label="kill")
+            try:
+                await client.oneway("exit_worker")
+            except Exception:
+                pass
+            finally:
+                await client.close()
+        return {"ok": True}
+
+    async def rpc_worker_died(self, node_id: str, worker_id: str, reason: str = ""):
+        """Node agent reports a worker process death."""
+        for actor in list(self.actors.values()):
+            if actor.worker_id == worker_id and actor.state in (ALIVE, PENDING):
+                await self._on_actor_worker_lost(
+                    actor, reason or f"worker {worker_id[:8]} died")
+        return {"ok": True}
+
+    async def _on_actor_worker_lost(self, actor: _ActorEntry, cause: str):
+        if actor.restarts_left == 0:
+            actor.state = DEAD
+            actor.death_cause = cause
+            if actor.name:
+                self.named_actors.pop(actor.name, None)
+            actor.wake()
+            return
+        if actor.restarts_left > 0:
+            actor.restarts_left -= 1
+        actor.state = RESTARTING
+        actor.wake()
+        asyncio.ensure_future(self._schedule_actor(actor))
+
+    async def _schedule_actor(self, actor: _ActorEntry):
+        """Pick a node, lease a worker there, push the creation task.
+
+        Reference: gcs_actor_scheduler.h — GCS leases workers from raylets
+        using the same protocol normal tasks do.
+        """
+        ts = TaskSpec.from_wire(actor.spec_wire)
+        demand = ts.resource_set()
+        delay = 0.05
+        for attempt in range(config.actor_creation_retries + 1):
+            cluster = {nid: n.resources for nid, n in self.nodes.items()}
+            nid = pick_node(cluster, demand, local_node_id="")
+            if nid is None:
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 2.0)
+                continue
+            node = self.nodes.get(nid)
+            if node is None:
+                continue
+            try:
+                lease = await self._node_client(node).call(
+                    "request_lease", spec=actor.spec_wire, grant_only=True,
+                    timeout=config.worker_lease_timeout_ms / 1000.0)
+            except Exception:
+                await asyncio.sleep(delay)
+                continue
+            if "granted" not in lease:
+                await asyncio.sleep(delay)
+                continue
+            g = lease["granted"]
+            # push the creation task directly to the leased worker
+            wclient = RpcClient(g["addr"][0], g["addr"][1], label="actor-create")
+            try:
+                reply = await wclient.call(
+                    "push_task", spec=actor.spec_wire, instance=actor.instance + 1,
+                    timeout=config.rpc_call_timeout_s)
+                if reply.get("error"):
+                    raise RpcError(f"actor constructor failed: {reply['error_str']}")
+            except RpcError as e:
+                # constructor raised: do not retry onto other nodes
+                actor.state = DEAD
+                actor.death_cause = str(e)
+                if actor.name:
+                    self.named_actors.pop(actor.name, None)
+                actor.wake()
+                await wclient.close()
+                try:
+                    await self._node_client(node).call(
+                        "return_lease", lease_id=g["lease_id"], kill_worker=True)
+                except Exception:
+                    pass
+                return
+            except Exception:
+                await wclient.close()
+                await asyncio.sleep(delay)
+                continue
+            await wclient.close()
+            actor.state = ALIVE
+            actor.instance += 1
+            actor.node_id = nid
+            actor.worker_id = g["worker_id"]
+            actor.addr = (g["addr"][0], g["addr"][1])
+            actor.wake()
+            return
+        actor.state = DEAD
+        actor.death_cause = "actor creation failed: no feasible node"
+        if actor.name:
+            self.named_actors.pop(actor.name, None)
+        actor.wake()
+
+    def _node_client(self, node: _NodeEntry) -> RpcClient:
+        if node.client is None or not node.client.connected:
+            node.client = RpcClient(node.host, node.port, label=f"agent-{node.node_id[:8]}")
+        return node.client
+
+    # ---- misc --------------------------------------------------------------
+
+    async def rpc_ping(self):
+        return {"pong": True, "time": time.time()}
+
+    async def rpc_cluster_resources(self):
+        total: Dict[str, float] = {}
+        avail: Dict[str, float] = {}
+        for n in self.nodes.values():
+            for k, v in n.resources.total.to_dict().items():
+                total[k] = total.get(k, 0) + v
+            for k, v in n.resources.available.to_dict().items():
+                avail[k] = avail.get(k, 0) + v
+        return {"total": total, "available": avail}
+
+    async def rpc_shutdown_cluster(self):
+        async def _bye():
+            for n in list(self.nodes.values()):
+                try:
+                    await self._node_client(n).oneway("shutdown_node")
+                except Exception:
+                    pass
+            await asyncio.sleep(0.05)
+            self._shutdown.set()
+
+        asyncio.ensure_future(_bye())
+        return {"ok": True}
+
+
+def main():
+    """Entry point: `python -m ray_tpu._private.head --port-file PATH`."""
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--port-file", default="")
+    args = ap.parse_args()
+
+    async def run():
+        svc = HeadService()
+        port = await svc.start(args.host, args.port)
+        if args.port_file:
+            tmp = args.port_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(port))
+            import os
+            os.replace(tmp, args.port_file)
+        sys.stdout.write(f"ray_tpu head listening on {args.host}:{port}\n")
+        sys.stdout.flush()
+        await svc.wait_for_shutdown()
+        await svc.stop()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

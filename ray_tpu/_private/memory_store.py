@@ -1,0 +1,127 @@
+"""In-process memory store for small objects.
+
+Equivalent of the reference's CoreWorkerMemoryStore
+(reference: src/ray/core_worker/store_provider/memory_store/memory_store.h):
+inlined task returns and errors live here, keyed by object id; values too
+large to inline are represented by an IN_PLASMA sentinel that redirects
+`get` to the shared-memory store.
+
+Thread model: the user thread blocks in `wait_ready`; the RPC IO thread
+calls `set_*` — coordination is a per-entry threading.Event.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+
+class _Entry:
+    __slots__ = ("event", "value", "raw", "error", "in_plasma", "node_addr")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value: Any = None       # cached deserialized value
+        self.raw: Optional[bytes] = None  # serialized inline bytes
+        self.error: Optional[BaseException] = None
+        self.in_plasma = False
+        self.node_addr: Optional[Tuple[str, int]] = None
+
+
+class MemoryStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, _Entry] = {}
+
+    def _entry(self, oid: str) -> _Entry:
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is None:
+                e = self._entries[oid] = _Entry()
+            return e
+
+    # ---- producer side -----------------------------------------------------
+
+    def ensure(self, oid: str) -> None:
+        """Pre-create a pending entry (a submitted task's future return)."""
+        self._entry(oid)
+
+    def set_value(self, oid: str, value: Any) -> None:
+        e = self._entry(oid)
+        e.value = value
+        e.event.set()
+
+    def set_raw(self, oid: str, raw: bytes) -> None:
+        """Store serialized inline bytes; deserialized lazily on first get."""
+        e = self._entry(oid)
+        e.raw = raw
+        e.event.set()
+
+    def set_error(self, oid: str, error: BaseException) -> None:
+        e = self._entry(oid)
+        e.error = error
+        e.event.set()
+
+    def set_in_plasma(self, oid: str, node_addr: Tuple[str, int]) -> None:
+        e = self._entry(oid)
+        e.in_plasma = True
+        e.node_addr = node_addr
+        e.event.set()
+
+    def reset(self, oid: str) -> None:
+        """Forget a resolution (used when re-executing a task for recovery)."""
+        with self._lock:
+            self._entries.pop(oid, None)
+
+    # ---- consumer side -----------------------------------------------------
+
+    def known(self, oid: str) -> bool:
+        with self._lock:
+            return oid in self._entries
+
+    def ready(self, oid: str) -> bool:
+        with self._lock:
+            e = self._entries.get(oid)
+        return e is not None and e.event.is_set()
+
+    def peek(self, oid: str) -> Optional[_Entry]:
+        with self._lock:
+            e = self._entries.get(oid)
+        return e if e is not None and e.event.is_set() else None
+
+    def wait_ready(self, oid: str, timeout: Optional[float] = None) -> Optional[_Entry]:
+        """Block until the entry resolves; None on timeout or unknown id."""
+        with self._lock:
+            e = self._entries.get(oid)
+        if e is None:
+            return None
+        if not e.event.wait(timeout):
+            return None
+        return e
+
+    def wait_any(self, oids: List[str], num_ready: int,
+                 timeout: Optional[float]) -> Set[str]:
+        """Poll-free wait for `num_ready` of `oids` (for ray.wait).
+
+        Uses a shared condition signaled piggyback on entry events via
+        polling at a short interval — entries are also settable from the
+        IO thread, so a simple bounded poll keeps this correct and simple.
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: Set[str] = set()
+        while True:
+            for oid in oids:
+                if oid not in ready and self.ready(oid):
+                    ready.add(oid)
+            if len(ready) >= num_ready:
+                return ready
+            if deadline is not None and time.monotonic() >= deadline:
+                return ready
+            remaining = 0.01 if deadline is None else min(
+                0.01, max(0.0, deadline - time.monotonic()))
+            time.sleep(remaining)
+
+    def evict(self, oid: str) -> None:
+        with self._lock:
+            self._entries.pop(oid, None)

@@ -1,0 +1,554 @@
+"""Node agent: the per-node daemon (raylet equivalent).
+
+Equivalent role to the reference's raylet
+(reference: src/ray/raylet/node_manager.h:125, worker_pool.h:104,
+local_object_manager.cc) plus the plasma store process (the StoreCore
+runs inside this agent's event loop — one fewer process hop than the
+reference, same shared-memory data path).
+
+Responsibilities:
+  - hosts the shared-memory object store (store_* RPCs serve the
+    PlasmaClient protocol in object_store.py)
+  - worker pool: forks `worker_main` processes, tracks registration,
+    reaps deaths and reports them to the head
+    (reference: worker_pool.h PopWorker / StartWorkerProcess)
+  - lease protocol: request_lease grants a worker + resources, queues
+    FIFO-with-resources when full, spills back to other nodes per the
+    hybrid policy (reference: node_manager.h:520 HandleRequestWorkerLease,
+    scheduling/policy/hybrid_scheduling_policy.h)
+  - object transfer: pull-based chunked fetch from peer agents
+    (reference: object_manager.h pull/push managers)
+  - heartbeats resource availability to the head; the reply carries the
+    cluster view used for spillback decisions (reference: ray_syncer)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private.config import config
+from ray_tpu._private.ids import NodeID, WorkerID
+from ray_tpu._private.object_store import StoreCore
+from ray_tpu._private.resources import NodeResources, ResourceSet
+from ray_tpu._private.rpc import RpcClient, RpcHost, RpcServer
+from ray_tpu._private.scheduler import LocalScheduler, pick_node
+from ray_tpu._private.task_spec import TaskSpec
+
+
+class _Worker:
+    __slots__ = ("worker_id", "pid", "proc", "port", "ready", "lease_id",
+                 "started_at")
+
+    def __init__(self, worker_id: str, proc: subprocess.Popen):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.pid = proc.pid
+        self.port: int = 0
+        self.ready = asyncio.Event()
+        self.lease_id: Optional[str] = None
+        self.started_at = time.monotonic()
+
+
+class _Lease:
+    __slots__ = ("lease_id", "worker", "resources")
+
+    def __init__(self, lease_id: str, worker: _Worker, resources: ResourceSet):
+        self.lease_id = lease_id
+        self.worker = worker
+        self.resources = resources
+
+
+class NodeAgent(RpcHost):
+    def __init__(self, head_addr: Tuple[str, int], session_dir: str,
+                 resources: Dict[str, float], arena_path: str = "",
+                 capacity: int = 0, is_head_node: bool = False,
+                 node_id: str = ""):
+        self.node_id = node_id or NodeID.from_random().hex()
+        self.head_addr = head_addr
+        self.session_dir = session_dir
+        self.is_head_node = is_head_node
+        self.arena_path = arena_path or os.path.join(
+            "/dev/shm", f"rt-arena-{self.node_id[:12]}")
+        self.capacity = capacity or config.object_store_memory_bytes
+        spill_dir = os.path.join(session_dir, f"spill-{self.node_id[:12]}")
+        self.store = StoreCore(self.arena_path, self.capacity, spill_dir)
+        self.resources = NodeResources(ResourceSet(resources))
+        self.local = LocalScheduler(self.resources)
+        self.cluster_view: Dict[str, Any] = {}
+        self._server: Optional[RpcServer] = None
+        self.port = 0
+        self.host = "127.0.0.1"
+        self._head: Optional[RpcClient] = None
+        self._peers: Dict[Tuple[str, int], RpcClient] = {}
+        # worker pool
+        self._workers: Dict[str, _Worker] = {}   # worker_id -> worker
+        self._idle: List[_Worker] = []
+        self._starting = 0
+        self._leases: Dict[str, _Lease] = {}
+        self._lease_counter = 0
+        self._lease_waiters: Dict[object, asyncio.Future] = {}
+        # in-flight pulls: oid -> future
+        self._pulls: Dict[str, asyncio.Future] = {}
+        self._tasks: List[asyncio.Task] = []
+        self._shutdown = asyncio.Event()
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self.host = host
+        self._server = RpcServer(self, host, port)
+        self.port = await self._server.start()
+        self._head = RpcClient(self.head_addr[0], self.head_addr[1], label="head")
+        reply = await self._head.call(
+            "register_node", node_id=self.node_id, host=self.host,
+            port=self.port, arena_path=self.arena_path,
+            resources=self.resources.total.to_dict(),
+            is_head_node=self.is_head_node)
+        self.cluster_view = reply.get("cluster", {})
+        self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
+        self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        for _ in range(config.worker_pool_prestart_workers):
+            self._spawn_worker()
+        return self.port
+
+    async def stop(self):
+        for t in self._tasks:
+            t.cancel()
+        for w in list(self._workers.values()):
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+        for w in list(self._workers.values()):
+            try:
+                w.proc.wait(timeout=2)
+            except Exception:
+                try:
+                    w.proc.kill()
+                except Exception:
+                    pass
+        if self._head:
+            await self._head.close()
+        for c in self._peers.values():
+            await c.close()
+        if self._server:
+            await self._server.stop()
+        self.store.close(unlink=True)
+        self._shutdown.set()
+
+    async def wait_for_shutdown(self):
+        await self._shutdown.wait()
+
+    async def _heartbeat_loop(self):
+        period = config.gcs_health_check_period_ms / 1000.0
+        while True:
+            try:
+                reply = await self._head.call(
+                    "heartbeat", node_id=self.node_id,
+                    available=self.resources.available.to_dict())
+                if "cluster" in reply:
+                    self.cluster_view = reply["cluster"]
+            except Exception:
+                pass
+            await asyncio.sleep(period)
+
+    # ---- object store RPCs (PlasmaClient protocol) -------------------------
+
+    async def rpc_store_create(self, oid: str, size: int, primary: bool = True):
+        return self.store.create(oid, size, primary=primary)
+
+    async def rpc_store_seal(self, oid: str):
+        self.store.seal(oid)
+        return {"ok": True}
+
+    async def rpc_store_abort(self, oid: str):
+        self.store.abort(oid)
+        return {"ok": True}
+
+    async def rpc_store_get(self, oids: List[str], client_id: str,
+                            wait_timeout: Optional[float] = None):
+        return await self.store.get(oids, client_id, wait_timeout=wait_timeout)
+
+    async def rpc_store_release(self, oid: str, client_id: str):
+        self.store.release(oid, client_id)
+
+    async def rpc_store_free(self, oids: List[str]):
+        self.store.free(oids)
+        return {"ok": True}
+
+    async def rpc_store_contains(self, oid: str):
+        return self.store.contains(oid)
+
+    async def rpc_store_usage(self):
+        return self.store.usage()
+
+    # ---- object transfer (pull-based, chunked) -----------------------------
+
+    async def rpc_obj_info(self, oid: str, pin_for: str = ""):
+        """Peer asks for size before pulling; pins so chunks stay valid."""
+        locs = await self.store.get([oid], pin_for or "xfer", wait_timeout=0.0)
+        loc = locs[0]
+        if loc is None or loc.get("deleted"):
+            return {"found": False}
+        return {"found": True, "size": loc["size"]}
+
+    async def rpc_obj_chunk(self, oid: str, offset: int, length: int):
+        entry = self.store.objects.get(oid)
+        if entry is None or not entry.sealed:
+            return {"found": False}
+        if entry.location == "shm":
+            data = bytes(self.store.arena.view[
+                entry.offset + offset: entry.offset + offset + length])
+        else:
+            with open(entry.path, "rb") as f:
+                f.seek(offset)
+                data = f.read(length)
+        return {"found": True, "data": data}
+
+    async def rpc_obj_unpin(self, oid: str, pin_for: str = ""):
+        self.store.release(oid, pin_for or "xfer")
+        return {"ok": True}
+
+    async def rpc_ensure_local(self, oid: str, src: Optional[List] = None):
+        """Pull oid into the local store from the node at `src` (host,port).
+
+        Concurrent pulls of the same oid are deduplicated
+        (reference: pull_manager.h).
+        """
+        if self.store.contains(oid):
+            return {"ok": True, "local": True}
+        if not src or (src[0] == self.host and src[1] == self.port):
+            return {"ok": False, "error": "object not local and no source"}
+        fut = self._pulls.get(oid)
+        if fut is None:
+            fut = asyncio.ensure_future(self._pull(oid, (src[0], src[1])))
+            self._pulls[oid] = fut
+            fut.add_done_callback(lambda _: self._pulls.pop(oid, None))
+        try:
+            await asyncio.shield(fut)
+            return {"ok": True}
+        except Exception as e:
+            return {"ok": False, "error": str(e)}
+
+    async def _pull(self, oid: str, src: Tuple[str, int]):
+        peer = self._peer(src)
+        pin_id = f"xfer:{self.node_id[:12]}"
+        info = await peer.call("obj_info", oid=oid, pin_for=pin_id)
+        if not info.get("found"):
+            raise KeyError(f"object {oid} not found at {src}")
+        size = info["size"]
+        try:
+            loc = self.store.create(oid, size, primary=False)
+            try:
+                chunk = config.object_transfer_chunk_bytes
+                pos = 0
+                while pos < size:
+                    n = min(chunk, size - pos)
+                    r = await peer.call("obj_chunk", oid=oid, offset=pos, length=n)
+                    if not r.get("found"):
+                        raise KeyError(f"object {oid} vanished at {src} mid-pull")
+                    data = r["data"]
+                    if loc["location"] == "shm":
+                        self.store.arena.view[
+                            loc["offset"] + pos: loc["offset"] + pos + len(data)] = data
+                    else:
+                        with open(loc["path"], "r+b") as f:
+                            f.seek(pos)
+                            f.write(data)
+                    pos += len(data)
+                self.store.seal(oid)
+            except BaseException:
+                self.store.abort(oid)
+                raise
+        finally:
+            try:
+                await peer.oneway("obj_unpin", oid=oid, pin_for=pin_id)
+            except Exception:
+                pass
+
+    def _peer(self, addr: Tuple[str, int]) -> RpcClient:
+        addr = (addr[0], addr[1])
+        client = self._peers.get(addr)
+        if client is None or not client.connected:
+            client = RpcClient(addr[0], addr[1], label=f"peer-{addr[1]}")
+            self._peers[addr] = client
+        return client
+
+    # ---- worker pool -------------------------------------------------------
+
+    def _spawn_worker(self) -> _Worker:
+        worker_id = WorkerID.from_random().hex()
+        env = dict(os.environ)
+        env.update({
+            "RT_HEAD_HOST": self.head_addr[0],
+            "RT_HEAD_PORT": str(self.head_addr[1]),
+            "RT_AGENT_HOST": self.host,
+            "RT_AGENT_PORT": str(self.port),
+            "RT_ARENA_PATH": self.arena_path,
+            "RT_NODE_ID": self.node_id,
+            "RT_WORKER_ID": worker_id,
+            "RT_SESSION_DIR": self.session_dir,
+        })
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"worker-{worker_id[:12]}.log"), "ab")
+        from ray_tpu._private.spawn import fast_python_cmd
+
+        cmd, env_up = fast_python_cmd("ray_tpu._private.worker_main")
+        env.update(env_up)
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=out, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        out.close()
+        w = _Worker(worker_id, proc)
+        self._workers[worker_id] = w
+        self._starting += 1
+        return w
+
+    async def rpc_worker_ready(self, worker_id: str, port: int):
+        w = self._workers.get(worker_id)
+        if w is None:
+            return {"ok": False}
+        w.port = port
+        self._starting = max(0, self._starting - 1)
+        if not w.ready.is_set():
+            w.ready.set()
+            self._idle.append(w)
+        self._drain_lease_queue()
+        return {"ok": True, "node_id": self.node_id}
+
+    async def _reap_loop(self):
+        """Poll child processes for deaths (reference: raylet SIGCHLD)."""
+        while True:
+            await asyncio.sleep(0.2)
+            for wid, w in list(self._workers.items()):
+                if w.proc.poll() is not None:
+                    self._on_worker_dead(wid, f"exit code {w.proc.returncode}")
+
+    def _on_worker_dead(self, worker_id: str, reason: str):
+        w = self._workers.pop(worker_id, None)
+        if w is None:
+            return
+        if w in self._idle:
+            self._idle.remove(w)
+        if not w.ready.is_set():
+            self._starting = max(0, self._starting - 1)
+            w.ready.set()  # wake lease grant path; it will re-check
+        if w.lease_id is not None:
+            lease = self._leases.pop(w.lease_id, None)
+            if lease is not None:
+                for tok in self.local.release(lease.resources):
+                    self._grant_token(tok)
+        self.store.release_client(worker_id)
+        if self._head is not None:
+            asyncio.ensure_future(self._report_worker_death(worker_id, reason))
+        self._drain_lease_queue()
+
+    async def _report_worker_death(self, worker_id: str, reason: str):
+        try:
+            await self._head.call("worker_died", node_id=self.node_id,
+                                  worker_id=worker_id, reason=reason)
+        except Exception:
+            pass
+
+    # ---- lease protocol ----------------------------------------------------
+
+    async def rpc_request_lease(self, spec: Dict[str, Any], grant_only: bool = False):
+        """Grant a worker lease for the task's resource shape.
+
+        Replies: {"granted": {...}} | {"spillback": {...}} | {"error": ...}
+        (reference: node_manager.h:520 HandleRequestWorkerLease — the
+        spillback reply mirrors the reference's retry_at_raylet_address).
+        """
+        ts = TaskSpec.from_wire(spec)
+        demand = ts.resource_set()
+        if not grant_only:
+            cluster = {
+                nid: NodeResources.from_dict(
+                    {"total": v["res"]["total"], "available": v["res"]["available"]})
+                for nid, v in self.cluster_view.items()
+            }
+            # our own view is fresher than the gossiped one
+            cluster[self.node_id] = self.resources
+            target = pick_node(
+                cluster, demand, self.node_id,
+                spread_threshold=config.scheduler_spread_threshold,
+                top_k_fraction=config.scheduler_top_k_fraction,
+                top_k_absolute=config.scheduler_top_k_absolute)
+            if target is None:
+                return {"error": "infeasible",
+                        "error_str": f"no node can ever satisfy {demand.to_dict()}"}
+            if target != self.node_id:
+                view = self.cluster_view.get(target)
+                if view is not None:
+                    return {"spillback": {"node_id": target, "addr": view["addr"]}}
+        if not self.resources.is_feasible(demand):
+            return {"error": "infeasible",
+                    "error_str": f"node cannot satisfy {demand.to_dict()}"}
+        if self.local.try_acquire(demand):
+            return await self._grant(demand)
+        # queue FIFO-with-resources
+        token = object()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._lease_waiters[token] = (fut, demand)
+        self.local.enqueue(token, demand)
+        try:
+            await asyncio.wait_for(fut, config.worker_lease_timeout_ms / 1000.0)
+        except asyncio.TimeoutError:
+            found, granted = self.local.cancel(token)
+            self._lease_waiters.pop(token, None)
+            for tok in granted:
+                self._grant_token(tok)
+            if not found and fut.done() and not fut.cancelled():
+                # granted between timeout and cancel; resources are ours
+                return await self._grant(demand, already_acquired=True)
+            # if not found and fut is cancelled, _grant_token already gave
+            # the acquired resources back — nothing more to do here
+            return {"error": "lease timeout",
+                    "error_str": "timed out waiting for resources"}
+        return await self._grant(demand, already_acquired=True)
+
+    def _grant_token(self, token: object):
+        entry = self._lease_waiters.pop(token, None)
+        if entry is None:
+            return
+        fut, demand = entry
+        if not fut.done():
+            fut.set_result(True)
+        else:
+            # waiter gave up after the queue acquired on its behalf
+            for tok in self.local.release(demand):
+                self._grant_token(tok)
+
+    def _drain_lease_queue(self):
+        for tok in self.local.drain():
+            self._grant_token(tok)
+
+    async def _grant(self, demand: ResourceSet, already_acquired: bool = False):
+        # `demand` resources are held; find or spawn a worker
+        if not already_acquired:
+            pass  # try_acquire already took them
+        worker = await self._pop_worker()
+        if worker is None:
+            for tok in self.local.release(demand):
+                self._grant_token(tok)
+            return {"error": "worker spawn failed",
+                    "error_str": "could not start a worker process"}
+        self._lease_counter += 1
+        lease_id = f"{self.node_id[:12]}-{self._lease_counter}"
+        lease = _Lease(lease_id, worker, demand)
+        worker.lease_id = lease_id
+        self._leases[lease_id] = lease
+        return {"granted": {
+            "lease_id": lease_id,
+            "worker_id": worker.worker_id,
+            "addr": [self.host, worker.port],
+            "node_id": self.node_id,
+        }}
+
+    async def _pop_worker(self) -> Optional[_Worker]:
+        while self._idle:
+            w = self._idle.pop()
+            if w.proc.poll() is None:
+                return w
+            self._on_worker_dead(w.worker_id, "dead on pop")
+        w = self._spawn_worker()
+        try:
+            await asyncio.wait_for(w.ready.wait(), config.worker_register_timeout_s)
+        except asyncio.TimeoutError:
+            try:
+                w.proc.kill()
+            except Exception:
+                pass
+            self._on_worker_dead(w.worker_id, "startup timeout")
+            return None
+        if w.worker_id not in self._workers:  # died during startup
+            return None
+        if w in self._idle:
+            self._idle.remove(w)
+        return w
+
+    async def rpc_return_lease(self, lease_id: str, kill_worker: bool = False):
+        lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            return {"ok": False}
+        w = lease.worker
+        w.lease_id = None
+        if kill_worker or w.proc.poll() is not None:
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+        else:
+            self._idle.append(w)
+        for tok in self.local.release(lease.resources):
+            self._grant_token(tok)
+        return {"ok": True}
+
+    # ---- misc --------------------------------------------------------------
+
+    async def rpc_node_info(self):
+        return {
+            "node_id": self.node_id,
+            "addr": [self.host, self.port],
+            "arena_path": self.arena_path,
+            "resources": self.resources.to_dict(),
+            "num_workers": len(self._workers),
+            "num_idle": len(self._idle),
+            "num_leases": len(self._leases),
+            "store": self.store.usage(),
+        }
+
+    async def rpc_ping(self):
+        return {"pong": True}
+
+    async def rpc_shutdown_node(self):
+        self._shutdown.set()
+
+
+def main():
+    """Entry: `python -m ray_tpu._private.node_agent ...`."""
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--head-host", required=True)
+    ap.add_argument("--head-port", type=int, required=True)
+    ap.add_argument("--session-dir", required=True)
+    ap.add_argument("--resources", default="{}")  # JSON dict
+    ap.add_argument("--capacity", type=int, default=0)
+    ap.add_argument("--is-head-node", action="store_true")
+    ap.add_argument("--port-file", default="")
+    ap.add_argument("--node-id", default="")
+    args = ap.parse_args()
+
+    async def run():
+        agent = NodeAgent(
+            (args.head_host, args.head_port), args.session_dir,
+            json.loads(args.resources), capacity=args.capacity,
+            is_head_node=args.is_head_node, node_id=args.node_id)
+        port = await agent.start()
+        if args.port_file:
+            tmp = args.port_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(f"{port}\n{agent.node_id}\n{agent.arena_path}")
+            os.replace(tmp, args.port_file)
+        sys.stdout.write(f"ray_tpu node agent {agent.node_id[:12]} on port {port}\n")
+        sys.stdout.flush()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, agent._shutdown.set)
+        await agent.wait_for_shutdown()
+        await agent.stop()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

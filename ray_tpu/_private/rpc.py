@@ -112,7 +112,15 @@ class RpcServer:
             while True:
                 try:
                     kind, req_id, method, payload = await _read_frame(reader)
-                except (asyncio.IncompleteReadError, ConnectionResetError, ConnectionLost):
+                except (asyncio.IncompleteReadError, ConnectionResetError,
+                        ConnectionLost):
+                    break
+                except Exception:
+                    # malformed frame or msgpack garbage: drop the peer,
+                    # never the server — but leave a trace for debugging
+                    import traceback
+
+                    traceback.print_exc()
                     break
                 if kind == _ONEWAY:
                     asyncio.ensure_future(self._run_oneway(conn, method, payload))
@@ -311,7 +319,19 @@ class EventLoopThread:
         return asyncio.run_coroutine_threadsafe(coro, self.loop)
 
     def stop(self):
-        self.loop.call_soon_threadsafe(self.loop.stop)
+        async def _drain():
+            tasks = [t for t in asyncio.all_tasks(self.loop)
+                     if t is not asyncio.current_task()]
+            for task in tasks:
+                task.cancel()
+            # let cancelled tasks run their (possibly awaiting) cleanup
+            await asyncio.gather(*tasks, return_exceptions=True)
+            self.loop.stop()
+
+        try:
+            asyncio.run_coroutine_threadsafe(_drain(), self.loop)
+        except RuntimeError:
+            pass
         self._thread.join(timeout=5)
 
 

@@ -76,7 +76,7 @@ def pick_node(
     local_node_id: str,
     spread_threshold: float = 0.5,
     top_k_fraction: float = 0.2,
-    top_k_absolute: int = 1,
+    top_k_absolute: int = 5,
     rng: Optional[random.Random] = None,
 ) -> Optional[str]:
     """Hybrid policy: choose the node to send a lease request to.
@@ -97,12 +97,16 @@ def pick_node(
 
     available = [(nid, nr) for nid, nr in cluster.items() if nr.can_fit(demand)]
     if available:
-        available.sort(key=lambda kv: kv[1].utilization())
+        # under-threshold nodes score strictly better than hot ones
+        # (reference: hybrid_scheduling_policy.h score buckets); the top-k
+        # random pick is only for herd avoidance among the best bucket
+        cold = [kv for kv in available if kv[1].utilization() < spread_threshold]
+        pool = cold or available
+        pool.sort(key=lambda kv: kv[1].utilization())
         # absolute floor is configurable (reference: ray_config_def.h
         # scheduler_top_k_fraction / scheduler_top_k_absolute)
-        k = min(len(available),
-                max(top_k_absolute, int(len(available) * top_k_fraction)))
-        return rng.choice(available[:k])[0]
+        k = min(len(pool), max(top_k_absolute, int(len(pool) * top_k_fraction)))
+        return rng.choice(pool[:k])[0]
 
     feasible = [nid for nid, nr in cluster.items() if nr.is_feasible(demand)]
     if feasible:

@@ -1,0 +1,1017 @@
+"""CoreWorker: the in-process runtime of every driver and worker.
+
+Equivalent of the reference's CoreWorker
+(reference: src/ray/core_worker/core_worker.h:291 — SubmitTask :910,
+SubmitActorTask :986, Put :584, Get :739; transport in
+src/ray/core_worker/transport/direct_task_transport.h:79 and
+direct_actor_task_submitter.h).
+
+Threading model (reference: core_worker_process.h io_service):
+  - the user's thread calls the public API (submit/get/put/wait)
+  - all RPC (one server + pooled clients) runs on one EventLoopThread
+  - worker mode executes tasks on the process main thread, fed by a
+    thread-safe queue from the RPC loop
+
+Task path (reference call stack SURVEY §3.2): submit → owner-side
+dependency resolution (inline promotion) → worker lease from the node
+agent (hybrid policy, spillback) → direct push_task RPC to the leased
+worker → returns inlined in the reply (< max_direct_call_object_size)
+or sealed into the worker-node's shared-memory store.
+
+Ownership (reference: reference_count.h): the submitter owns task
+returns and its own puts.  Borrows are registered race-free by
+piggybacking on the task reply ("borrows": arg refs the worker kept;
+"nested": refs embedded in returns, acked before the worker drops its
+pins).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import cloudpickle
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import config
+from ray_tpu._private.errors import (ActorDiedError, GetTimeoutError,
+                                     ObjectFreedError, ObjectLostError,
+                                     RayTaskError, RayWorkerError,
+                                     SchedulingError)
+from ray_tpu._private.function_manager import FunctionManager
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.memory_store import MemoryStore
+from ray_tpu._private.object_ref import ObjectRef, SerializationContext
+from ray_tpu._private.object_store import PlasmaClient
+from ray_tpu._private.reference_count import ReferenceCounter
+from ray_tpu._private.rpc import (ConnectionLost, EventLoopThread, RpcClient,
+                                  RpcError, RpcHost, RpcServer, SyncRpcClient)
+from ray_tpu._private.task_spec import (ACTOR_CREATION_TASK, ACTOR_TASK,
+                                        NORMAL_TASK, TaskSpec, WireArg)
+
+MODE_DRIVER = "driver"
+MODE_WORKER = "worker"
+
+_TASK_PUSH_TIMEOUT = 7 * 86400.0  # tasks may legitimately run for days
+_LEASE_LINGER_S = 0.2
+_MAX_LEASES_PER_CLASS = 16
+_MAX_ACTOR_INFLIGHT = 1000
+
+_global_worker: Optional["CoreWorker"] = None
+_global_lock = threading.Lock()
+
+
+def global_worker_or_none() -> Optional["CoreWorker"]:
+    return _global_worker
+
+
+def set_global_worker(w: Optional["CoreWorker"]) -> None:
+    global _global_worker
+    with _global_lock:
+        _global_worker = w
+
+
+class _ExecState(threading.local):
+    task_id: str = ""
+    job_id: str = ""
+    put_index: int = 0
+
+
+class _TaskState:
+    __slots__ = ("spec", "contained_refs", "retries_left", "sched_key",
+                 "return_oids")
+
+    def __init__(self, spec: TaskSpec, contained_refs: List[ObjectRef]):
+        self.spec = spec
+        self.contained_refs = contained_refs
+        self.retries_left = spec.max_retries
+        self.sched_key = spec.scheduling_class()
+        self.return_oids = [
+            ObjectID.from_index(TaskID.from_hex(spec.task_id), i + 1).hex()
+            for i in range(spec.num_returns)
+        ]
+
+
+class _Lease:
+    __slots__ = ("lease_id", "worker_id", "addr", "agent_addr", "busy",
+                 "linger_handle", "dead")
+
+    def __init__(self, lease_id: str, worker_id: str, addr: Tuple[str, int],
+                 agent_addr: Tuple[str, int]):
+        self.lease_id = lease_id
+        self.worker_id = worker_id
+        self.addr = addr
+        self.agent_addr = agent_addr
+        self.busy: Optional[_TaskState] = None
+        self.linger_handle = None
+        self.dead = False
+
+
+class _SchedState:
+    __slots__ = ("pending", "leases", "inflight_requests")
+
+    def __init__(self):
+        self.pending: deque = deque()
+        self.leases: List[_Lease] = []
+        self.inflight_requests = 0
+
+
+class _ActorState:
+    __slots__ = ("actor_id", "addr", "instance", "pending", "inflight",
+                 "pumping", "recovering", "dead", "death_cause", "seq")
+
+    def __init__(self, actor_id: str):
+        self.actor_id = actor_id
+        self.addr: Optional[Tuple[str, int]] = None
+        self.instance = -1
+        self.pending: deque = deque()
+        self.inflight: Dict[int, _TaskState] = {}
+        self.pumping = False
+        self.recovering = False
+        self.dead = False
+        self.death_cause = ""
+        self.seq = 0
+
+
+class CoreWorker(RpcHost):
+    def __init__(self, mode: str, head_addr: Tuple[str, int],
+                 agent_addr: Tuple[str, int], arena_path: str,
+                 node_id: str, worker_id: str = "", job_id: str = ""):
+        self.mode = mode
+        self.node_id = node_id
+        self.worker_id = worker_id or WorkerID.from_random().hex()
+        self.head_addr = head_addr
+        self.agent_addr = tuple(agent_addr)
+        self._io = EventLoopThread(name=f"rt-io-{mode}")
+        self._server = RpcServer(self, "127.0.0.1", 0)
+        port = self._io.run(self._server.start())
+        self.address: Tuple[str, int] = ("127.0.0.1", port)
+        self.head = SyncRpcClient(head_addr[0], head_addr[1], self._io, label="head")
+        self.agent = SyncRpcClient(agent_addr[0], agent_addr[1], self._io, label="agent")
+        if not job_id:
+            job_id = self.head.call("register_job")["job_id"]
+        self.job_id = job_id
+        self.plasma = PlasmaClient(arena_path, self.agent, client_id=self.worker_id)
+        self.memory = MemoryStore()
+        self.rc = ReferenceCounter(self._free_object)
+        self.functions = FunctionManager(self.head)
+        self._locations: Dict[str, Tuple[str, int]] = {}  # owned oid -> node
+        self._containers: Dict[str, List[ObjectRef]] = {}  # outer -> inner pins
+        self._sched: Dict[tuple, _SchedState] = {}
+        self._actors: Dict[str, _ActorState] = {}
+        self._agent_clients: Dict[Tuple[str, int], RpcClient] = {}
+        self._worker_clients: Dict[Tuple[str, int], RpcClient] = {}
+        self._exec = _ExecState()
+        self._exec.job_id = job_id
+        self._exec.task_id = TaskID.for_driver(JobID.from_hex(job_id)).hex()
+        self._put_counter = 0
+        self._put_lock = threading.Lock()
+        self._shutdown = False
+        # worker-mode execution state
+        self._task_queue: "queue.Queue" = queue.Queue()
+        self._actor_instance: Any = None
+        self._actor_creation_spec: Optional[TaskSpec] = None
+        self._pending_acks: Dict[str, Any] = {}  # task_id -> held values
+        self._exec_threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------------------ utils
+
+    def _loop(self):
+        return self._io.loop
+
+    def _spawn(self, coro):
+        """Fire-and-forget a coroutine on the IO loop from any thread."""
+        if self._shutdown:
+            coro.close()
+            return
+        try:
+            self._io.spawn(coro)
+        except RuntimeError:
+            coro.close()
+
+    async def _aclient_worker(self, addr: Tuple[str, int]) -> RpcClient:
+        addr = (addr[0], addr[1])
+        c = self._worker_clients.get(addr)
+        if c is None or not c.connected:
+            c = RpcClient(addr[0], addr[1], label=f"worker-{addr[1]}")
+            self._worker_clients[addr] = c
+        return c
+
+    async def _aclient_agent(self, addr: Tuple[str, int]) -> RpcClient:
+        addr = (addr[0], addr[1])
+        c = self._agent_clients.get(addr)
+        if c is None or not c.connected:
+            c = RpcClient(addr[0], addr[1], label=f"agent-{addr[1]}")
+            self._agent_clients[addr] = c
+        return c
+
+    def shutdown(self):
+        self._shutdown = True
+        try:
+            self.plasma.close()
+        except Exception:
+            pass
+        for c in (self.head, self.agent):
+            try:
+                c.close()
+            except Exception:
+                pass
+
+        async def _close_all():
+            for c in list(self._agent_clients.values()) + list(self._worker_clients.values()):
+                await c.close()
+            await self._server.stop()
+
+        try:
+            self._io.run(_close_all(), timeout=5)
+        except Exception:
+            pass
+        self._io.stop()
+
+    # ---------------------------------------------------------- ref plumbing
+
+    def register_local_ref(self, ref: ObjectRef) -> None:
+        if self._shutdown:
+            return
+        owned = ref.owner_addr is None or tuple(ref.owner_addr) == self.address
+        self.rc.add_local(ref.oid, owned)
+
+    def unregister_local_ref(self, ref: ObjectRef) -> None:
+        if self._shutdown:
+            return
+        borrowed_done = self.rc.remove_local(ref.oid)
+        if borrowed_done and ref.owner_addr is not None:
+            self._spawn(self._send_remove_borrow(tuple(ref.owner_addr), ref.oid))
+
+    async def _send_remove_borrow(self, owner: Tuple[str, int], oid: str):
+        try:
+            c = await self._aclient_worker(owner)
+            await c.oneway("remove_borrow", oid=oid, borrower=list(self.address))
+        except Exception:
+            pass
+
+    def _free_object(self, oid: str) -> None:
+        """Owned object's refcount hit zero: drop the value everywhere."""
+        if self._shutdown:
+            return
+        self.memory.evict(oid)
+        self._containers.pop(oid, None)  # releases nested pins via GC
+        loc = self._locations.pop(oid, None)
+        if loc is not None:
+            self._spawn(self._send_free(loc, oid))
+
+    async def _send_free(self, node: Tuple[str, int], oid: str):
+        try:
+            c = await self._aclient_agent(node)
+            await c.call("store_free", oids=[oid])
+        except Exception:
+            pass
+
+    # ---- borrower/owner RPCs ----
+
+    async def rpc_add_borrow(self, oid: str, borrower: List):
+        self.rc.add_borrower(oid, (borrower[0], borrower[1]))
+        return {"ok": True}
+
+    async def rpc_remove_borrow(self, oid: str, borrower: List):
+        self.rc.remove_borrower(oid, (borrower[0], borrower[1]))
+
+    async def rpc_fetch_object(self, oid: str, wait: float = 0.0):
+        """Owner-side object resolution for borrowers
+        (reference: ownership-based object directory)."""
+        entry = self.memory.peek(oid)
+        if entry is None and wait > 0 and self.memory.known(oid):
+            e = self.memory._entry(oid)
+            await self._loop().run_in_executor(None, e.event.wait, min(wait, 10.0))
+            entry = self.memory.peek(oid)
+        if entry is not None:
+            if entry.error is not None:
+                return {"error": cloudpickle.dumps(entry.error)}
+            if entry.in_plasma:
+                return {"plasma": list(entry.node_addr)}
+            if entry.raw is not None:
+                return {"inline": entry.raw}
+            return {"inline": serialization.serialize_to_bytes(entry.value)}
+        loc = self._locations.get(oid)
+        if loc is not None:
+            return {"plasma": list(loc)}
+        if self.rc.is_freed(oid):
+            return {"freed": True}
+        if self.memory.known(oid):
+            return {"pending": True}
+        return {"unknown": True}
+
+    async def rpc_task_ack(self, task_id: str):
+        self._pending_acks.pop(task_id, None)
+
+    async def rpc_ping(self):
+        return {"pong": True, "mode": self.mode}
+
+    # ------------------------------------------------------------------- put
+
+    def _next_put_oid(self) -> str:
+        with self._put_lock:
+            self._put_counter += 1
+            idx = 100 + self._put_counter  # return indices stay below 100
+        tid = TaskID.from_hex(self._exec.task_id or
+                              TaskID.for_driver(JobID.from_hex(self.job_id)).hex())
+        return ObjectID.from_index(tid, idx).hex()
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = self._next_put_oid()
+        with SerializationContext() as ctx:
+            frames, size = serialization.serialize(value)
+        self.plasma.put_serialized(oid, frames, size, primary=True)
+        self._locations[oid] = self.agent_addr
+        if ctx.refs:
+            # the stored value embeds refs: pin them for the outer's lifetime
+            self._containers[oid] = list(ctx.refs)
+        return ObjectRef(oid, owner_addr=self.address, node_addr=self.agent_addr)
+
+    # ------------------------------------------------------------------- get
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out: List[Any] = [None] * len(refs)
+        plasma_fetch: List[Tuple[int, ObjectRef, Tuple[str, int]]] = []
+        for i, ref in enumerate(refs):
+            oid = ref.oid
+            if self.memory.known(oid):
+                remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+                entry = self.memory.wait_ready(oid, remaining)
+                if entry is None:
+                    raise GetTimeoutError(f"timed out waiting for {oid[:16]}")
+                if entry.error is not None:
+                    raise entry.error
+                if entry.in_plasma:
+                    plasma_fetch.append((i, ref, entry.node_addr))
+                else:
+                    if entry.value is None and entry.raw is not None:
+                        with SerializationContext():
+                            entry.value = serialization.deserialize(entry.raw)
+                    out[i] = entry.value
+            elif self.rc.is_freed(oid):
+                raise ObjectFreedError(f"object {oid[:16]} was freed by its owner")
+            else:
+                node = ref.node_addr
+                if node is None and ref.owner_addr is not None \
+                        and tuple(ref.owner_addr) != self.address:
+                    node = self._resolve_via_owner(ref, deadline)
+                    if node is None:
+                        continue  # value already placed in out by resolver
+                if node is None:
+                    node = self._locations.get(oid, self.agent_addr)
+                plasma_fetch.append((i, ref, node))
+        if plasma_fetch:
+            self._fetch_plasma(plasma_fetch, out, deadline)
+        return out
+
+    def _resolve_via_owner(self, ref: ObjectRef, deadline) -> Optional[Tuple[str, int]]:
+        """Ask the owner where the object lives; may inline the value.
+
+        Returns a node address for the plasma path, or None if the value
+        was resolved inline (stored into memory store under the oid).
+        """
+        owner = tuple(ref.owner_addr)
+        while True:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise GetTimeoutError(f"timed out resolving {ref.oid[:16]}")
+            wait = 10.0 if remaining is None else min(10.0, remaining)
+            try:
+                r = self._io.run(self._afetch_from_owner(owner, ref.oid, wait),
+                                 timeout=wait + 30.0)
+            except ConnectionLost:
+                raise ObjectLostError(
+                    f"owner of {ref.oid[:16]} at {owner} is unreachable")
+            if r.get("pending"):
+                continue
+            if r.get("freed"):
+                raise ObjectFreedError(f"object {ref.oid[:16]} was freed by its owner")
+            if r.get("unknown"):
+                raise ObjectLostError(f"owner does not know object {ref.oid[:16]}")
+            if "error" in r:
+                raise cloudpickle.loads(r["error"])
+            if "inline" in r:
+                self.memory.set_raw(ref.oid, r["inline"])
+                return None
+            return (r["plasma"][0], r["plasma"][1])
+
+    async def _afetch_from_owner(self, owner, oid: str, wait: float):
+        c = await self._aclient_worker(owner)
+        return await c.call("fetch_object", oid=oid, wait=wait,
+                            timeout=wait + 20.0)
+
+    def _fetch_plasma(self, items, out: List[Any], deadline) -> None:
+        # 1. make everything local (pulls run concurrently on the IO loop)
+        async def _ensure_all():
+            import asyncio
+            coros = []
+            for i, ref, node in items:
+                async def one(oid=ref.oid, node=node):
+                    return await self.agent.aio.call(
+                        "ensure_local", oid=oid, src=list(node) if node else None,
+                        timeout=config.rpc_call_timeout_s)
+                coros.append(one())
+            return await asyncio.gather(*coros, return_exceptions=True)
+
+        replies = self._io.run(_ensure_all(), timeout=config.rpc_call_timeout_s + 30)
+        for (i, ref, node), r in zip(items, replies):
+            if isinstance(r, Exception) or not r.get("ok"):
+                err = r if isinstance(r, Exception) else r.get("error")
+                raise ObjectLostError(f"could not localize {ref.oid[:16]}: {err}")
+        # 2. read them zero-copy from the local store
+        remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+        oids = [ref.oid for _, ref, _ in items]
+        with SerializationContext() as ctx:
+            try:
+                values = self.plasma.get_values(oids, timeout=remaining)
+            except KeyError as e:
+                if "freed" in str(e):
+                    raise ObjectFreedError(str(e)) from e
+                raise ObjectLostError(str(e)) from e
+        self._register_foreign_refs(ctx.refs)
+        for (i, _, _), v in zip(items, values):
+            out[i] = v
+
+    def _register_foreign_refs(self, refs: List[ObjectRef]) -> None:
+        """Register borrows for refs materialized out of fetched values."""
+        seen: Set[str] = set()
+        for r in refs:
+            if r.owner_addr is not None and tuple(r.owner_addr) != self.address \
+                    and r.oid not in seen:
+                seen.add(r.oid)
+                self._spawn(self._send_add_borrow(tuple(r.owner_addr), r.oid))
+
+    async def _send_add_borrow(self, owner: Tuple[str, int], oid: str):
+        try:
+            c = await self._aclient_worker(owner)
+            await c.call("add_borrow", oid=oid, borrower=list(self.address))
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------ wait
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: List[ObjectRef] = []
+        pending = list(refs)
+        while True:
+            still = []
+            for ref in pending:
+                if self.memory.ready(ref.oid):
+                    ready.append(ref)
+                elif ref.node_addr is not None or not self.memory.known(ref.oid):
+                    # plasma-path object: ask the local store
+                    try:
+                        if self.plasma.contains(ref.oid):
+                            ready.append(ref)
+                        else:
+                            still.append(ref)
+                    except Exception:
+                        still.append(ref)
+                else:
+                    still.append(ref)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                return ready, pending
+            if deadline is not None and time.monotonic() >= deadline:
+                return ready, pending
+            time.sleep(0.005)
+
+    # ---------------------------------------------------------- task submit
+
+    def _serialize_args(self, args: tuple, kwargs: dict) -> Tuple[List[WireArg], List[ObjectRef]]:
+        wire: List[WireArg] = []
+        contained: List[ObjectRef] = []
+        items = [(None, a) for a in args] + list(kwargs.items())
+        for kw, a in items:
+            if isinstance(a, ObjectRef):
+                contained.append(a)
+                wire.append(WireArg(object_id=a.oid,
+                                    owner_addr=a.owner_addr or self.address, kw=kw))
+                continue
+            with SerializationContext() as ctx:
+                blob = serialization.serialize_to_bytes(a)
+            contained.extend(ctx.refs)
+            if len(blob) > config.max_direct_call_object_size:
+                # big literal arg: put once, pass by ref
+                ref = self.put(a)
+                contained.append(ref)
+                wire.append(WireArg(object_id=ref.oid, owner_addr=self.address, kw=kw))
+            else:
+                wire.append(WireArg(value=blob, kw=kw))
+        return wire, contained
+
+    def submit_task(self, function_id: str, args: tuple, kwargs: dict,
+                    num_returns: int = 1, resources: Optional[Dict[str, float]] = None,
+                    max_retries: int = 3, name: str = "") -> List[ObjectRef]:
+        tid = TaskID.for_normal_task(JobID.from_hex(self.job_id))
+        wire_args, contained = self._serialize_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=tid.hex(), job_id=self.job_id, kind=NORMAL_TASK,
+            function_id=function_id, args=wire_args, num_returns=num_returns,
+            resources=resources or {"CPU": 1}, max_retries=max_retries,
+            name=name, owner_addr=self.address, caller_id=self.worker_id)
+        task = _TaskState(spec, contained)
+        refs = []
+        for oid in task.return_oids:
+            self.memory.ensure(oid)
+            refs.append(ObjectRef(oid, owner_addr=self.address))
+        self._spawn(self._submit(task))
+        return refs
+
+    async def _submit(self, task: _TaskState):
+        # owner-side dependency resolution (reference: dependency_resolver.h)
+        ok = await self._resolve_deps(task)
+        if not ok:
+            return
+        state = self._sched.setdefault(task.sched_key, _SchedState())
+        state.pending.append(task)
+        self._pump(state)
+
+    async def _resolve_deps(self, task: _TaskState) -> bool:
+        for arg in task.spec.args:
+            if arg.object_id is None:
+                continue
+            oid = arg.object_id
+            if not self.memory.known(oid):
+                continue  # plasma object or foreign ref: worker will fetch
+            e = self.memory._entry(oid)
+            if not e.event.is_set():
+                await self._loop().run_in_executor(None, e.event.wait)
+            if e.error is not None:
+                self._fail_task(task, e.error)
+                return False
+            if e.in_plasma:
+                arg.owner_addr = self.address
+            elif e.raw is not None:
+                arg.value = e.raw
+                arg.object_id = None
+            else:
+                arg.value = serialization.serialize_to_bytes(e.value)
+                arg.object_id = None
+        return True
+
+    def _fail_task(self, task: _TaskState, error: BaseException):
+        for oid in task.return_oids:
+            self.memory.set_error(oid, error)
+        task.contained_refs = []
+
+    def _pump(self, state: _SchedState):
+        # hand pending tasks to idle leases
+        idle = [l for l in state.leases if l.busy is None and not l.dead]
+        while state.pending and idle:
+            lease = idle.pop()
+            task = state.pending.popleft()
+            self._assign(state, lease, task)
+        if not state.pending:
+            # no demand: linger-return every idle lease (a lease granted
+            # after the queue drained would otherwise pin resources forever)
+            for lease in state.leases:
+                if lease.busy is None and not lease.dead \
+                        and lease.linger_handle is None:
+                    self._schedule_linger(state, lease)
+            return
+        # request more leases if there is unmet demand
+        deficit = len(state.pending) - state.inflight_requests
+        capacity = _MAX_LEASES_PER_CLASS - len(state.leases) - state.inflight_requests
+        for _ in range(max(0, min(deficit, capacity))):
+            state.inflight_requests += 1
+            self._spawn(self._request_lease(state, state.pending[0].spec))
+
+    async def _request_lease(self, state: _SchedState, spec: TaskSpec):
+        try:
+            agent_addr = self.agent_addr
+            for _hop in range(8):
+                try:
+                    c = await self._aclient_agent(agent_addr)
+                    reply = await c.call(
+                        "request_lease", spec=spec.to_wire(),
+                        timeout=config.worker_lease_timeout_ms / 1000.0 + 10.0)
+                except (ConnectionLost, RpcError):
+                    if agent_addr == self.agent_addr:
+                        raise
+                    agent_addr = self.agent_addr  # spillback target died: retry home
+                    continue
+                if "spillback" in reply:
+                    agent_addr = tuple(reply["spillback"]["addr"])
+                    continue
+                if "granted" in reply:
+                    g = reply["granted"]
+                    lease = _Lease(g["lease_id"], g["worker_id"],
+                                   (g["addr"][0], g["addr"][1]), agent_addr)
+                    state.leases.append(lease)
+                    return
+                if reply.get("error") == "infeasible":
+                    err = SchedulingError(reply.get("error_str", "infeasible"))
+                    while state.pending:
+                        self._fail_task(state.pending.popleft(), err)
+                    return
+                # lease timeout: retry while there is still demand
+                if not state.pending:
+                    return
+        finally:
+            state.inflight_requests -= 1
+            self._pump(state)
+
+    def _assign(self, state: _SchedState, lease: _Lease, task: _TaskState):
+        lease.busy = task
+        if lease.linger_handle is not None:
+            lease.linger_handle.cancel()
+            lease.linger_handle = None
+        self._spawn(self._push(state, lease, task))
+
+    async def _push(self, state: _SchedState, lease: _Lease, task: _TaskState):
+        try:
+            c = await self._aclient_worker(lease.addr)
+            reply = await c.call("push_task", spec=task.spec.to_wire(),
+                                 timeout=_TASK_PUSH_TIMEOUT)
+        except (ConnectionLost, RpcError, Exception) as e:
+            self._drop_lease(state, lease, kill=True)
+            if task.retries_left != 0:
+                if task.retries_left > 0:
+                    task.retries_left -= 1
+                await self._sleep(config.task_retry_delay_ms / 1000.0)
+                state.pending.appendleft(task)
+            else:
+                self._fail_task(task, RayWorkerError(
+                    f"worker {lease.worker_id[:8]} died running "
+                    f"{task.spec.name or task.spec.function_id[:8]}: {e}"))
+            self._pump(state)
+            return
+        await self._process_reply(task, reply, lease.addr)
+        lease.busy = None
+        self._pump(state)
+
+    async def _sleep(self, s: float):
+        import asyncio
+        await asyncio.sleep(s)
+
+    def _schedule_linger(self, state: _SchedState, lease: _Lease):
+        if lease.linger_handle is not None:
+            lease.linger_handle.cancel()
+        lease.linger_handle = self._loop().call_later(
+            _LEASE_LINGER_S, lambda: self._spawn(self._return_lease(state, lease)))
+
+    async def _return_lease(self, state: _SchedState, lease: _Lease, kill=False):
+        if lease.busy is not None or lease.dead:
+            return
+        lease.dead = True
+        if lease in state.leases:
+            state.leases.remove(lease)
+        try:
+            c = await self._aclient_agent(lease.agent_addr)
+            await c.call("return_lease", lease_id=lease.lease_id, kill_worker=kill)
+        except Exception:
+            pass
+
+    def _drop_lease(self, state: _SchedState, lease: _Lease, kill: bool):
+        lease.dead = True
+        lease.busy = None
+        if lease in state.leases:
+            state.leases.remove(lease)
+        self._spawn(self._notify_drop(lease, kill))
+
+    async def _notify_drop(self, lease: _Lease, kill: bool):
+        try:
+            c = await self._aclient_agent(lease.agent_addr)
+            await c.call("return_lease", lease_id=lease.lease_id, kill_worker=kill)
+        except Exception:
+            pass
+
+    async def _process_reply(self, task: _TaskState, reply: Dict[str, Any],
+                             worker_addr: Tuple[str, int]):
+        results = reply.get("results", [])
+        nested_all: Dict[str, List] = reply.get("nested") or {}
+        for i, oid in enumerate(task.return_oids):
+            r = results[i] if i < len(results) else {"err": cloudpickle.dumps(
+                RayWorkerError("missing return value"))}
+            nested = nested_all.get(oid) or []
+            if nested:
+                inner_refs = []
+                for n_oid, n_owner, n_node in nested:
+                    ref = ObjectRef(n_oid,
+                                    owner_addr=tuple(n_owner) if n_owner else None,
+                                    node_addr=tuple(n_node) if n_node else None)
+                    inner_refs.append(ref)
+                    if ref.owner_addr is not None and tuple(ref.owner_addr) != self.address:
+                        await self._send_add_borrow(tuple(ref.owner_addr), n_oid)
+                self._containers[oid] = inner_refs
+            if "err" in r:
+                try:
+                    exc = cloudpickle.loads(r["err"])
+                except Exception:
+                    exc = RayTaskError(task.spec.name or "task", "<unpicklable error>")
+                self.memory.set_error(oid, exc)
+            elif "v" in r:
+                self.memory.set_raw(oid, r["v"])
+            elif "stored" in r:
+                node = tuple(r["stored"]["node"])
+                self._locations[oid] = node
+                self.memory.set_in_plasma(oid, node)
+        for b_oid in reply.get("borrows") or []:
+            self.rc.add_borrower(b_oid, worker_addr)
+        if reply.get("needs_ack"):
+            try:
+                c = await self._aclient_worker(worker_addr)
+                await c.oneway("task_ack", task_id=task.spec.task_id)
+            except Exception:
+                pass
+        task.contained_refs = []  # release submission pins
+
+    # ---------------------------------------------------------- actor submit
+
+    def create_actor(self, class_id: str, args: tuple, kwargs: dict,
+                     resources: Optional[Dict[str, float]] = None,
+                     max_restarts: int = 0, max_task_retries: int = 0,
+                     max_concurrency: int = 1, name: str = "") -> str:
+        aid = ActorID.of(JobID.from_hex(self.job_id))
+        tid = TaskID.for_actor_creation(aid)
+        wire_args, contained = self._serialize_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=tid.hex(), job_id=self.job_id, kind=ACTOR_CREATION_TASK,
+            function_id=class_id, args=wire_args, num_returns=0,
+            resources=resources or {"CPU": 1}, actor_id=aid.hex(),
+            max_restarts=max_restarts, max_concurrency=max_concurrency,
+            max_retries=max_task_retries, name=name,
+            owner_addr=self.address, caller_id=self.worker_id)
+        self.head.call("create_actor", spec=spec.to_wire(), name=name)
+        # hold arg refs until the actor is alive; the head owns creation
+        astate = _ActorState(aid.hex())
+        self._actors[aid.hex()] = astate
+        # keep contained refs pinned for the actor's lifetime (v1: simple)
+        self._containers[f"actor:{aid.hex()}"] = contained
+        return aid.hex()
+
+    def submit_actor_task(self, actor_id: str, method_name: str, args: tuple,
+                          kwargs: dict, num_returns: int = 1,
+                          max_retries: int = 0) -> List[ObjectRef]:
+        astate = self._actors.get(actor_id)
+        if astate is None:
+            astate = self._actors.setdefault(actor_id, _ActorState(actor_id))
+        tid = TaskID.for_actor_task(ActorID.from_hex(actor_id))
+        wire_args, contained = self._serialize_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=tid.hex(), job_id=self.job_id, kind=ACTOR_TASK,
+            args=wire_args, num_returns=num_returns, resources={},
+            max_retries=max_retries, actor_id=actor_id,
+            method_name=method_name, caller_id=self.worker_id,
+            owner_addr=self.address)
+        task = _TaskState(spec, contained)
+        refs = []
+        for oid in task.return_oids:
+            self.memory.ensure(oid)
+            refs.append(ObjectRef(oid, owner_addr=self.address))
+        self._spawn(self._actor_submit(astate, task))
+        return refs
+
+    async def _actor_submit(self, astate: _ActorState, task: _TaskState):
+        if astate.dead:
+            self._fail_task(task, ActorDiedError(astate.death_cause or "actor is dead"))
+            return
+        task.spec.seqno = astate.seq
+        astate.seq += 1
+        ok = await self._resolve_deps(task)
+        if not ok:
+            return
+        astate.pending.append(task)
+        await self._actor_pump(astate)
+
+    async def _actor_pump(self, astate: _ActorState):
+        if astate.recovering or astate.dead:
+            return
+        if astate.addr is None:
+            await self._actor_resolve(astate)
+            if astate.dead or astate.recovering:
+                return
+        while astate.pending and len(astate.inflight) < _MAX_ACTOR_INFLIGHT:
+            task = astate.pending.popleft()
+            astate.inflight[task.spec.seqno] = task
+            self._spawn(self._actor_push(astate, task, astate.instance))
+
+    async def _actor_resolve(self, astate: _ActorState, known_instance: int = -1):
+        try:
+            info = await self.head.aio.call(
+                "get_actor_info", actor_id=astate.actor_id, wait=True,
+                known_instance=known_instance,
+                timeout=config.pubsub_poll_timeout_ms / 1000.0 + 10.0)
+        except Exception as e:
+            astate.dead = True
+            astate.death_cause = f"cannot reach head service: {e}"
+            self._actor_fail_all(astate)
+            return
+        if info["state"] == "ALIVE":
+            astate.addr = tuple(info["addr"])
+            astate.instance = info["instance"]
+        elif info["state"] == "DEAD":
+            astate.dead = True
+            astate.death_cause = info.get("death_cause", "actor died")
+            self._actor_fail_all(astate)
+        # PENDING/RESTARTING after long-poll timeout: stay unresolved; the
+        # next pump retries
+
+    def _actor_fail_all(self, astate: _ActorState):
+        err = ActorDiedError(astate.death_cause or "actor died")
+        for task in list(astate.inflight.values()):
+            self._fail_task(task, err)
+        astate.inflight.clear()
+        while astate.pending:
+            self._fail_task(astate.pending.popleft(), err)
+
+    async def _actor_push(self, astate: _ActorState, task: _TaskState, instance: int):
+        try:
+            c = await self._aclient_worker(astate.addr)
+            reply = await c.call("push_task", spec=task.spec.to_wire(),
+                                 timeout=_TASK_PUSH_TIMEOUT)
+        except (ConnectionLost, Exception) as e:
+            await self._actor_recover(astate, task, instance, e)
+            return
+        await self._process_reply(task, reply, astate.addr)
+        astate.inflight.pop(task.spec.seqno, None)
+        await self._actor_pump(astate)
+
+    async def _actor_recover(self, astate: _ActorState, task: _TaskState,
+                             instance: int, error: Exception):
+        """Connection to the actor failed mid-call."""
+        astate.inflight.pop(task.spec.seqno, None)
+        if task.retries_left != 0:
+            if task.retries_left > 0:
+                task.retries_left -= 1
+            # retryable: goes back to the front, re-sent after re-resolve
+            astate.pending.appendleft(task)
+        else:
+            self._fail_task(task, ActorDiedError(
+                f"actor task {task.spec.method_name} failed: worker died ({error})"))
+        if astate.recovering or astate.dead:
+            return
+        astate.recovering = True
+        try:
+            if astate.instance == instance:  # nobody re-resolved yet
+                astate.addr = None
+                await self._actor_resolve(astate, known_instance=instance)
+        finally:
+            astate.recovering = False
+        await self._actor_pump(astate)
+
+    def kill_actor_async(self, actor_id: str):
+        """Non-blocking kill, safe from __del__/GC contexts."""
+        async def _kill():
+            try:
+                await self.head.aio.call("kill_actor", actor_id=actor_id,
+                                         no_restart=True)
+            except Exception:
+                pass
+
+        self._spawn(_kill())
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True):
+        self.head.call("kill_actor", actor_id=actor_id, no_restart=no_restart)
+        astate = self._actors.get(actor_id)
+        if astate is not None:
+            astate.dead = True
+            astate.death_cause = "killed via ray_tpu.kill"
+        self._containers.pop(f"actor:{actor_id}", None)
+
+    # ------------------------------------------------------- task execution
+
+    async def rpc_push_task(self, spec: Dict[str, Any], instance: int = 0):
+        """Execute a pushed task (worker mode). Runs user code on the exec
+        thread; this handler awaits completion and carries the results back
+        in the reply (reference: core_worker.proto PushTask)."""
+        import asyncio
+
+        fut = self._loop().create_future()
+        self._task_queue.put((spec, fut))
+        return await fut
+
+    async def rpc_exit_worker(self):
+        self._task_queue.put(None)
+
+    def exec_loop(self):
+        """Worker main loop: executes tasks until exit (reference:
+        python/ray/_private/workers/default_worker.py main loop)."""
+        while True:
+            item = self._task_queue.get()
+            if item is None:
+                break
+            spec_wire, fut = item
+            reply = self._execute(spec_wire)
+            self._loop().call_soon_threadsafe(
+                lambda f=fut, r=reply: (not f.done()) and f.set_result(r))
+
+    def _execute(self, spec_wire: Dict[str, Any]) -> Dict[str, Any]:
+        spec = TaskSpec.from_wire(spec_wire)
+        self._exec.task_id = spec.task_id
+        self._exec.job_id = spec.job_id
+        try:
+            args, kwargs, arg_ref_oids = self._materialize_args(spec)
+        except BaseException as e:
+            return self._error_reply(spec, e, traceback.format_exc())
+        try:
+            if spec.kind == ACTOR_CREATION_TASK:
+                cls = self.functions.fetch(spec.function_id)
+                self._actor_instance = cls(*args, **kwargs)
+                self._actor_creation_spec = spec
+                return {"results": []}
+            if spec.kind == ACTOR_TASK:
+                if self._actor_instance is None:
+                    raise ActorDiedError("actor instance not initialized")
+                fn = getattr(self._actor_instance, spec.method_name)
+                value = fn(*args, **kwargs)
+            else:
+                fn = self.functions.fetch(spec.function_id)
+                value = fn(*args, **kwargs)
+        except BaseException as e:
+            return self._error_reply(spec, e, traceback.format_exc())
+        return self._success_reply(spec, value, arg_ref_oids)
+
+    def _materialize_args(self, spec: TaskSpec):
+        """Deserialize inline args and batch-fetch ref args, preserving
+        positional order."""
+        slots: List[Tuple[Optional[str], Any]] = []
+        collected: List[ObjectRef] = []
+        ref_list: List[ObjectRef] = []
+        ref_slots: List[int] = []
+        for arg in spec.args:
+            if arg.object_id is not None:
+                ref = ObjectRef(arg.object_id, owner_addr=arg.owner_addr)
+                ref_list.append(ref)
+                ref_slots.append(len(slots))
+                slots.append((arg.kw, None))
+            else:
+                with SerializationContext() as ctx:
+                    val = serialization.deserialize(arg.value)
+                collected.extend(ctx.refs)
+                slots.append((arg.kw, val))
+        if ref_list:
+            values = self.get(ref_list)
+            for si, v in zip(ref_slots, values):
+                slots[si] = (slots[si][0], v)
+            collected.extend(ref_list)
+        self._register_foreign_refs(collected)
+        args = [v for kw, v in slots if not kw]
+        kwargs = {kw: v for kw, v in slots if kw}
+        return args, kwargs, {r.oid for r in collected}
+
+    def _success_reply(self, spec: TaskSpec, value: Any,
+                       arg_ref_oids: Set[str]) -> Dict[str, Any]:
+        if spec.num_returns == 0:
+            values = []
+        elif spec.num_returns == 1:
+            values = [value]
+        else:
+            values = list(value)
+            if len(values) != spec.num_returns:
+                return self._error_reply(spec, ValueError(
+                    f"task declared num_returns={spec.num_returns} but returned "
+                    f"{len(values)} values"), "")
+        results = []
+        nested: Dict[str, List] = {}
+        needs_ack = False
+        held = []
+        tid = TaskID.from_hex(spec.task_id)
+        for i, v in enumerate(values):
+            oid = ObjectID.from_index(tid, i + 1).hex()
+            with SerializationContext() as ctx:
+                frames, size = serialization.serialize(v)
+            if ctx.refs:
+                nested[oid] = [[r.oid, list(r.owner_addr) if r.owner_addr else None,
+                                list(r.node_addr) if r.node_addr else None]
+                               for r in ctx.refs]
+                needs_ack = True
+                held.append((v, list(ctx.refs)))
+            if size <= config.max_direct_call_object_size:
+                blob = bytearray(size)
+                serialization.pack_into(frames, memoryview(blob))
+                results.append({"v": bytes(blob)})
+            else:
+                self.plasma.put_serialized(oid, frames, size, primary=True)
+                results.append({"stored": {"oid": oid, "node": list(self.agent_addr)}})
+        borrows = [oid for oid in arg_ref_oids if self.rc.count(oid) > 0]
+        reply: Dict[str, Any] = {"results": results}
+        if borrows:
+            reply["borrows"] = borrows
+        if nested:
+            reply["nested"] = nested
+            reply["needs_ack"] = True
+            self._pending_acks[spec.task_id] = held
+            self._loop().call_later(60.0, lambda: self._pending_acks.pop(spec.task_id, None))
+        return reply
+
+    def _error_reply(self, spec: TaskSpec, exc: BaseException, tb: str) -> Dict[str, Any]:
+        name = spec.name or spec.method_name or spec.function_id[:8]
+        try:
+            wrapped = RayTaskError(name, tb, cause=exc)
+            blob = cloudpickle.dumps(wrapped)
+        except Exception:
+            blob = cloudpickle.dumps(RayTaskError(name, tb))
+        n = max(1, spec.num_returns)
+        return {"results": [{"err": blob} for _ in range(n)],
+                "error": True, "error_str": f"{type(exc).__name__}: {exc}"}

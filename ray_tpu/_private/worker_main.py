@@ -1,0 +1,46 @@
+"""Worker process entry point.
+
+Equivalent of the reference's default_worker.py
+(reference: python/ray/_private/workers/default_worker.py): the node
+agent's worker pool forks this executable; it connects back to its agent
+and the head, then executes pushed tasks on the main thread until told
+to exit.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main():
+    head = (os.environ["RT_HEAD_HOST"], int(os.environ["RT_HEAD_PORT"]))
+    agent = (os.environ["RT_AGENT_HOST"], int(os.environ["RT_AGENT_PORT"]))
+    arena = os.environ["RT_ARENA_PATH"]
+    node_id = os.environ["RT_NODE_ID"]
+    worker_id = os.environ["RT_WORKER_ID"]
+
+    from ray_tpu._private.ids import JobID
+    from ray_tpu._private.worker import CoreWorker, MODE_WORKER, set_global_worker
+
+    worker = CoreWorker(MODE_WORKER, head, agent, arena, node_id,
+                        worker_id=worker_id, job_id=JobID.nil().hex())
+    set_global_worker(worker)
+    reply = worker.agent.call("worker_ready", worker_id=worker_id,
+                              port=worker.address[1])
+    if not reply.get("ok"):
+        sys.stderr.write("agent rejected worker registration\n")
+        sys.exit(1)
+    # first `import jax` in a task will register the TPU PJRT plugin
+    from ray_tpu._private.spawn import install_jax_site_hook
+
+    install_jax_site_hook()
+    try:
+        worker.exec_loop()
+    finally:
+        set_global_worker(None)
+        worker.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,386 @@
+"""Public API: init/shutdown, @remote, get/put/wait, actors.
+
+Equivalent of the reference's driver API surface
+(reference: python/ray/_private/worker.py — ray.init :1217, ray.get
+:2533, ray.put :2665, ray.wait :2730, ray.remote :3123;
+python/ray/remote_function.py:276 RemoteFunction._remote;
+python/ray/actor.py:857 ActorClass._remote).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from ray_tpu._private.errors import RayError
+from ray_tpu._private.object_ref import ObjectRef
+
+_state_lock = threading.RLock()
+_global_node: Optional[Dict[str, Any]] = None  # procs + addrs when we own them
+
+
+def is_initialized() -> bool:
+    from ray_tpu._private.worker import global_worker_or_none
+
+    return global_worker_or_none() is not None
+
+
+def _worker():
+    from ray_tpu._private.worker import global_worker_or_none
+
+    w = global_worker_or_none()
+    if w is None:
+        raise RayError("ray_tpu.init() has not been called")
+    return w
+
+
+def init(address: Optional[str] = None, *,
+         num_cpus: Optional[float] = None,
+         resources: Optional[Dict[str, float]] = None,
+         object_store_memory: Optional[int] = None,
+         _system_config: Optional[Dict[str, Any]] = None,
+         ignore_reinit_error: bool = False):
+    """Start (or connect to) a cluster and attach this process as a driver.
+
+    With no address, spawns a head service and one node agent locally
+    (reference: worker.py:1217 bootstrap path). With address="host:port",
+    connects to an existing head and uses the head node's agent.
+    """
+    from ray_tpu._private import node as node_mod
+    from ray_tpu._private.config import config
+    from ray_tpu._private.rpc import EventLoopThread, SyncRpcClient
+    from ray_tpu._private.worker import CoreWorker, MODE_DRIVER, \
+        global_worker_or_none, set_global_worker
+
+    global _global_node
+    with _state_lock:
+        if global_worker_or_none() is not None:
+            if ignore_reinit_error:
+                return
+            raise RayError("ray_tpu.init() called twice")
+        config.initialize(_system_config)
+        env = {}
+        if _system_config:
+            env = config.deserialize_into_env(config.serialize())
+            import os
+
+            os.environ.update(env)
+        if address is None:
+            session_dir = node_mod.new_session_dir()
+            head_proc, head_addr = node_mod.start_head(session_dir, env=env)
+            res = node_mod.default_resources(num_cpus, resources)
+            agent_proc, info = node_mod.start_node_agent(
+                session_dir, head_addr, res,
+                object_store_memory=object_store_memory,
+                is_head_node=True, env=env)
+            _global_node = {"procs": [agent_proc, head_proc],
+                            "session_dir": session_dir}
+        else:
+            host, port_s = address.rsplit(":", 1)
+            head_addr = (host, int(port_s))
+            io = EventLoopThread(name="rt-init")
+            try:
+                head = SyncRpcClient(head_addr[0], head_addr[1], io, label="head")
+                table = head.call("node_table")
+                head.close()
+            finally:
+                io.stop()
+            entry = next((v for v in table.values() if v.get("is_head_node")),
+                         next(iter(table.values()), None))
+            if entry is None:
+                raise RayError(f"no node agents registered at {address}")
+            info = {"addr": tuple(entry["addr"]), "node_id": entry["node_id"],
+                    "arena_path": entry["arena_path"]}
+            _global_node = None
+        worker = CoreWorker(MODE_DRIVER, head_addr, info["addr"],
+                            info["arena_path"], info["node_id"])
+        set_global_worker(worker)
+        return
+
+
+def shutdown():
+    from ray_tpu._private.worker import global_worker_or_none, set_global_worker
+
+    global _global_node
+    with _state_lock:
+        w = global_worker_or_none()
+        if w is not None:
+            if _global_node is not None:
+                try:
+                    w.head.call("shutdown_cluster", timeout=2.0)
+                except Exception:
+                    pass
+            set_global_worker(None)
+            w.shutdown()
+        if _global_node is not None:
+            for p in _global_node["procs"]:
+                p.terminate()
+            _global_node = None
+
+
+def put(value: Any) -> ObjectRef:
+    return _worker().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None) -> Any:
+    w = _worker()
+    if isinstance(refs, ObjectRef):
+        return w.get([refs], timeout=timeout)[0]
+    return w.get(list(refs), timeout=timeout)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None
+         ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds the number of refs")
+    return _worker().wait(refs, num_returns=num_returns, timeout=timeout)
+
+
+def kill(actor: "ActorHandle", *, no_restart: bool = True):
+    _worker().kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def get_actor(name: str) -> "ActorHandle":
+    w = _worker()
+    reply = w.head.call("get_named_actor", name=name)
+    if not reply.get("found"):
+        raise ValueError(f"no actor named {name!r}")
+    return ActorHandle(reply["actor_id"])
+
+
+def cluster_resources() -> Dict[str, float]:
+    return _worker().head.call("cluster_resources")["total"]
+
+
+def available_resources() -> Dict[str, float]:
+    return _worker().head.call("cluster_resources")["available"]
+
+
+def nodes() -> List[Dict[str, Any]]:
+    table = _worker().head.call("node_table")
+    return list(table.values())
+
+
+# --------------------------------------------------------------------- tasks
+
+
+class RemoteFunction:
+    """Handle produced by @remote on a function
+    (reference: python/ray/remote_function.py)."""
+
+    def __init__(self, fn, *, num_returns: int = 1,
+                 num_cpus: Optional[float] = None,
+                 num_gpus: Optional[float] = None,
+                 num_tpus: Optional[float] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 max_retries: int = 3, name: str = ""):
+        self._fn = fn
+        self._num_returns = num_returns
+        self._resources = _build_resources(num_cpus, num_gpus, num_tpus,
+                                           resources, default_cpu=1)
+        self._max_retries = max_retries
+        self._name = name or getattr(fn, "__qualname__", getattr(fn, "__name__", "fn"))
+        self._function_id: Optional[str] = None
+        self.__doc__ = getattr(fn, "__doc__", None)
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = dict(
+            num_returns=opts.get("num_returns", self._num_returns),
+            num_cpus=opts.get("num_cpus"),
+            num_gpus=opts.get("num_gpus"),
+            num_tpus=opts.get("num_tpus"),
+            resources=opts.get("resources"),
+            max_retries=opts.get("max_retries", self._max_retries),
+            name=opts.get("name", self._name),
+        )
+        rf = RemoteFunction(self._fn, **merged)
+        if not any(opts.get(k) is not None
+                   for k in ("num_cpus", "num_gpus", "num_tpus", "resources")):
+            rf._resources = self._resources
+        return rf
+
+    def remote(self, *args, **kwargs):
+        w = _worker()
+        if self._function_id is None:
+            self._function_id = w.functions.export(self._fn)
+        refs = w.submit_task(
+            self._function_id, args, kwargs, num_returns=self._num_returns,
+            resources=self._resources, max_retries=self._max_retries,
+            name=self._name)
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self._name} cannot be called directly; "
+            f"use {self._name}.remote(...)")
+
+
+def _build_resources(num_cpus, num_gpus, num_tpus, resources,
+                     default_cpu: float) -> Dict[str, float]:
+    out: Dict[str, float] = dict(resources or {})
+    out["CPU"] = float(num_cpus) if num_cpus is not None else float(default_cpu)
+    if num_gpus is not None:
+        out["GPU"] = float(num_gpus)
+    if num_tpus is not None:
+        out["TPU"] = float(num_tpus)
+    return out
+
+
+# -------------------------------------------------------------------- actors
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str):
+        self._handle = handle
+        self._name = name
+
+    def remote(self, *args, **kwargs):
+        h = self._handle
+        w = _worker()
+        num_returns = h._method_num_returns.get(self._name, 1)
+        refs = w.submit_actor_task(
+            h._actor_id, self._name, args, kwargs, num_returns=num_returns,
+            max_retries=h._max_task_retries)
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def options(self, *, num_returns: int = 1):
+        m = ActorMethod(self._handle, self._name)
+        m.remote = lambda *a, **kw: self._remote_n(num_returns, *a, **kw)
+        return m
+
+    def _remote_n(self, num_returns, *args, **kwargs):
+        w = _worker()
+        refs = w.submit_actor_task(
+            self._handle._actor_id, self._name, args, kwargs,
+            num_returns=num_returns,
+            max_retries=self._handle._max_task_retries)
+        return refs[0] if num_returns == 1 else refs
+
+    def __call__(self, *a, **kw):
+        raise TypeError(f"Actor method {self._name} must be called with .remote()")
+
+
+class ActorHandle:
+    """Serializable handle to a remote actor
+    (reference: python/ray/actor.py ActorHandle).  The handle returned by
+    `.remote()` owns the actor's lifetime: when it is garbage collected
+    the actor is terminated (reference: out-of-scope actor GC).  Copies
+    obtained by serialization or get_actor do not own the actor."""
+
+    def __init__(self, actor_id: str, max_task_retries: int = 0,
+                 method_num_returns: Optional[Dict[str, int]] = None,
+                 _owner: bool = False):
+        self._actor_id = actor_id
+        self._max_task_retries = max_task_retries
+        self._method_num_returns = method_num_returns or {}
+        self._owner = _owner
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __reduce__(self):
+        return (ActorHandle,
+                (self._actor_id, self._max_task_retries, self._method_num_returns))
+
+    def __del__(self):
+        if getattr(self, "_owner", False):
+            try:
+                from ray_tpu._private.worker import global_worker_or_none
+
+                w = global_worker_or_none()
+                if w is not None:
+                    w.kill_actor_async(self._actor_id)
+            except Exception:
+                pass
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id[:16]}…)"
+
+
+class ActorClass:
+    def __init__(self, cls, *, num_cpus=None, num_gpus=None, num_tpus=None,
+                 resources=None, max_restarts: int = 0,
+                 max_task_retries: int = 0, max_concurrency: int = 1,
+                 name: str = "", lifetime: str = ""):
+        self._cls = cls
+        # actors hold 0 CPUs while alive unless explicitly requested
+        # (reference: ray actor default num_cpus=0 post-creation, so many
+        # actors coexist on few cores)
+        self._resources = _build_resources(num_cpus, num_gpus, num_tpus,
+                                           resources, default_cpu=0)
+        self._max_restarts = max_restarts
+        self._max_task_retries = max_task_retries
+        self._max_concurrency = max_concurrency
+        self._name = name
+        self._lifetime = lifetime
+        self._class_id: Optional[str] = None
+        self.__doc__ = getattr(cls, "__doc__", None)
+
+    def options(self, **opts) -> "ActorClass":
+        ac = ActorClass(
+            self._cls,
+            num_cpus=opts.get("num_cpus"),
+            num_gpus=opts.get("num_gpus"),
+            num_tpus=opts.get("num_tpus"),
+            resources=opts.get("resources"),
+            max_restarts=opts.get("max_restarts", self._max_restarts),
+            max_task_retries=opts.get("max_task_retries", self._max_task_retries),
+            max_concurrency=opts.get("max_concurrency", self._max_concurrency),
+            name=opts.get("name", self._name),
+            lifetime=opts.get("lifetime", ""),
+        )
+        if not any(opts.get(k) is not None
+                   for k in ("num_cpus", "num_gpus", "num_tpus", "resources")):
+            ac._resources = self._resources
+        return ac
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        w = _worker()
+        if self._class_id is None:
+            self._class_id = w.functions.export(self._cls)
+        actor_id = w.create_actor(
+            self._class_id, args, kwargs, resources=self._resources,
+            max_restarts=self._max_restarts,
+            max_task_retries=self._max_task_retries,
+            max_concurrency=self._max_concurrency, name=self._name)
+        owner = self._lifetime != "detached"
+        return ActorHandle(actor_id, max_task_retries=self._max_task_retries,
+                           _owner=owner)
+
+    def __call__(self, *a, **kw):
+        raise TypeError("Actor classes must be instantiated with .remote()")
+
+
+# ------------------------------------------------------------------- remote
+
+
+def remote(*args, **kwargs):
+    """@remote decorator for functions and classes
+    (reference: python/ray/_private/worker.py:3123)."""
+
+    def make(target):
+        if isinstance(target, type):
+            cls_opts = {k: v for k, v in kwargs.items()
+                        if k in ("num_cpus", "num_gpus", "num_tpus", "resources",
+                                 "max_restarts", "max_task_retries",
+                                 "max_concurrency", "name", "lifetime")}
+            return ActorClass(target, **cls_opts)
+        fn_opts = {k: v for k, v in kwargs.items()
+                   if k in ("num_returns", "num_cpus", "num_gpus", "num_tpus",
+                            "resources", "max_retries", "name")}
+        return RemoteFunction(target, **fn_opts)
+
+    if len(args) == 1 and not kwargs and (callable(args[0]) or isinstance(args[0], type)):
+        return make(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. "
+                        "@remote(num_cpus=2)")
+    return make
