@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Benchmark harness. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extras": {...}}
+
+Headline: single-client sync task throughput, directly comparable to the
+reference's ray_perf.py microbenchmark ("single client tasks sync",
+reference: python/ray/_private/ray_perf.py:174; recorded value 1006.9
+tasks/s in release/release_logs/2.9.3/microbenchmark.json).
+
+Also measured (extras): async task throughput, actor call throughput,
+object-store put bandwidth, and a Llama train-step throughput inside a
+worker (on the real TPU chip when one is attached; CPU otherwise).
+
+The driver process never imports jax — the TPU is claimed by the worker
+actor that runs the train benchmark.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def bench_tasks_sync(ray_tpu, n=300):
+    @ray_tpu.remote
+    def e():
+        return b"ok"
+
+    ray_tpu.get(e.remote(), timeout=60)  # warm lease
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(e.remote(), timeout=60)
+    return n / (time.perf_counter() - t0)
+
+
+def bench_tasks_async(ray_tpu, n=2000):
+    @ray_tpu.remote
+    def e():
+        return b"ok"
+
+    ray_tpu.get([e.remote() for _ in range(50)], timeout=60)
+    t0 = time.perf_counter()
+    ray_tpu.get([e.remote() for _ in range(n)], timeout=120)
+    return n / (time.perf_counter() - t0)
+
+
+def bench_actor(ray_tpu, n_sync=300, n_async=2000):
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return b"ok"
+
+    a = A.remote()
+    ray_tpu.get(a.m.remote(), timeout=60)
+    t0 = time.perf_counter()
+    for _ in range(n_sync):
+        ray_tpu.get(a.m.remote(), timeout=60)
+    sync = n_sync / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    ray_tpu.get([a.m.remote() for _ in range(n_async)], timeout=120)
+    return sync, n_async / (time.perf_counter() - t0)
+
+
+def bench_put_gbps(ray_tpu, mb=100, iters=5):
+    import numpy as np
+
+    data = np.random.rand(mb * 1024 * 1024 // 8)
+    refs = []
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        refs.append(ray_tpu.put(data))
+    dt = time.perf_counter() - t0
+    del refs
+    return iters * mb / 1024 / dt
+
+
+def _train_bench_loop():
+    """Runs inside a worker actor; imports jax there (claims the chip)."""
+    import jax
+
+    platform = jax.devices()[0].platform
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+    from ray_tpu.train.gspmd import build_llama_train_state, param_count
+
+    if platform == "tpu":
+        cfg, batch, seq, steps = LlamaConfig.small(), 8, 1024, 20
+    else:
+        cfg, batch, seq, steps = LlamaConfig.tiny(), 4, 128, 5
+    mesh = make_mesh(MeshSpec(dp=-1), devices=jax.devices()[:1])
+    params, opt, step_fn, _ = build_llama_train_state(
+        cfg, mesh, batch_size=batch, seq_len=seq)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (batch, seq), 0,
+                                cfg.vocab_size, dtype="int32")
+    params, opt, loss = step_fn(params, opt, tokens)  # compile
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt, loss = step_fn(params, opt, tokens)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    tokens_per_s = steps * batch * seq / dt
+    n_params = param_count(params)
+    # MFU: 6 * params * tokens/s over peak flops (v5e: 197e12 bf16)
+    peak = 197e12 if platform == "tpu" else 0
+    mfu = (6 * n_params * tokens_per_s / peak) if peak else 0.0
+    return {"platform": platform, "train_tokens_per_s": round(tokens_per_s, 1),
+            "params": n_params, "mfu_pct": round(100 * mfu, 2),
+            "loss": float(loss)}
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4),
+                 object_store_memory=1024 * 1024 * 1024)
+    extras = {}
+    try:
+        sync = bench_tasks_sync(ray_tpu)
+        extras["tasks_async_per_s"] = round(bench_tasks_async(ray_tpu), 1)
+        a_sync, a_async = bench_actor(ray_tpu)
+        extras["actor_sync_per_s"] = round(a_sync, 1)
+        extras["actor_async_per_s"] = round(a_async, 1)
+        extras["put_gb_per_s"] = round(bench_put_gbps(ray_tpu), 2)
+        train_actor = ray_tpu.remote(_TrainBench).remote()
+        extras.update(ray_tpu.get(train_actor.run.remote(), timeout=1200))
+    finally:
+        ray_tpu.shutdown()
+    print(json.dumps({
+        "metric": "single-client sync tasks/s (ray_perf.py:174 equivalent)",
+        "value": round(sync, 1),
+        "unit": "tasks/s",
+        "vs_baseline": round(sync / 1006.9, 3),
+        "extras": extras,
+    }))
+
+
+class _TrainBench:
+    def run(self):
+        return _train_bench_loop()
+
+
+if __name__ == "__main__":
+    main()

@@ -44,13 +44,14 @@ PENDING, ALIVE, RESTARTING, DEAD = "PENDING", "ALIVE", "RESTARTING", "DEAD"
 class _ActorEntry:
     __slots__ = ("actor_id", "spec_wire", "state", "node_id", "worker_id",
                  "addr", "instance", "restarts_left", "name", "waiters",
-                 "death_cause")
+                 "death_cause", "kill_requested")
 
     def __init__(self, actor_id: str, spec_wire: Dict[str, Any], name: str,
                  max_restarts: int):
         self.actor_id = actor_id
         self.spec_wire = spec_wire
         self.state = PENDING
+        self.kill_requested = False
         self.node_id: str = ""
         self.worker_id: str = ""
         self.addr: Optional[Tuple[str, int]] = None
@@ -274,6 +275,7 @@ class HeadService(RpcHost):
             return {"ok": False}
         if no_restart:
             entry.restarts_left = 0
+            entry.kill_requested = True
         if entry.state == ALIVE and entry.addr is not None:
             client = RpcClient(entry.addr[0], entry.addr[1], label="kill")
             try:
@@ -282,6 +284,14 @@ class HeadService(RpcHost):
                 pass
             finally:
                 await client.close()
+        elif entry.state in (PENDING, RESTARTING) and no_restart:
+            # creation still in flight: _schedule_actor checks
+            # kill_requested after the push and tears the instance down
+            entry.state = DEAD
+            entry.death_cause = "killed before creation completed"
+            if entry.name:
+                self.named_actors.pop(entry.name, None)
+            entry.wake()
         return {"ok": True}
 
     async def rpc_worker_died(self, node_id: str, worker_id: str, reason: str = ""):
@@ -316,6 +326,8 @@ class HeadService(RpcHost):
         demand = ts.resource_set()
         delay = 0.05
         for attempt in range(config.actor_creation_retries + 1):
+            if actor.kill_requested or actor.state == DEAD:
+                return
             cluster = {nid: n.resources for nid, n in self.nodes.items()}
             nid = pick_node(cluster, demand, local_node_id="")
             if nid is None:
@@ -336,12 +348,22 @@ class HeadService(RpcHost):
                 await asyncio.sleep(delay)
                 continue
             g = lease["granted"]
-            # push the creation task directly to the leased worker
+
+            async def _drop_lease():
+                try:
+                    await self._node_client(node).call(
+                        "return_lease", lease_id=g["lease_id"], kill_worker=True)
+                except Exception:
+                    pass
+
+            # push the creation task directly to the leased worker; a
+            # constructor may legitimately run for a long time (model
+            # load), so use the task-push timeout, not the RPC default
             wclient = RpcClient(g["addr"][0], g["addr"][1], label="actor-create")
             try:
                 reply = await wclient.call(
                     "push_task", spec=actor.spec_wire, instance=actor.instance + 1,
-                    timeout=config.rpc_call_timeout_s)
+                    timeout=7 * 86400.0)
                 if reply.get("error"):
                     raise RpcError(f"actor constructor failed: {reply['error_str']}")
             except RpcError as e:
@@ -352,17 +374,24 @@ class HeadService(RpcHost):
                     self.named_actors.pop(actor.name, None)
                 actor.wake()
                 await wclient.close()
-                try:
-                    await self._node_client(node).call(
-                        "return_lease", lease_id=g["lease_id"], kill_worker=True)
-                except Exception:
-                    pass
+                await _drop_lease()
                 return
             except Exception:
+                # transport failure: give the lease back before retrying
                 await wclient.close()
+                await _drop_lease()
                 await asyncio.sleep(delay)
                 continue
             await wclient.close()
+            if actor.kill_requested:
+                # killed while the constructor ran: tear the instance down
+                actor.state = DEAD
+                actor.death_cause = actor.death_cause or "killed during creation"
+                if actor.name:
+                    self.named_actors.pop(actor.name, None)
+                actor.wake()
+                await _drop_lease()
+                return
             actor.state = ALIVE
             actor.instance += 1
             actor.node_id = nid

@@ -453,26 +453,33 @@ class NodeAgent(RpcHost):
         }}
 
     async def _pop_worker(self) -> Optional[_Worker]:
-        while self._idle:
-            w = self._idle.pop()
-            if w.proc.poll() is None:
-                return w
-            self._on_worker_dead(w.worker_id, "dead on pop")
-        w = self._spawn_worker()
-        try:
-            await asyncio.wait_for(w.ready.wait(), config.worker_register_timeout_s)
-        except asyncio.TimeoutError:
+        for _attempt in range(3):
+            while self._idle:
+                w = self._idle.pop()
+                if w.proc.poll() is None:
+                    return w
+                self._on_worker_dead(w.worker_id, "dead on pop")
+            w = self._spawn_worker()
             try:
-                w.proc.kill()
-            except Exception:
-                pass
-            self._on_worker_dead(w.worker_id, "startup timeout")
-            return None
-        if w.worker_id not in self._workers:  # died during startup
-            return None
-        if w in self._idle:
-            self._idle.remove(w)
-        return w
+                await asyncio.wait_for(w.ready.wait(),
+                                       config.worker_register_timeout_s)
+            except asyncio.TimeoutError:
+                try:
+                    w.proc.kill()
+                except Exception:
+                    pass
+                self._on_worker_dead(w.worker_id, "startup timeout")
+                return None
+            if w.worker_id not in self._workers:  # died during startup
+                return None
+            if w.lease_id is not None:
+                # a queued lease drained on worker_ready and claimed this
+                # worker before our wait resumed — start over
+                continue
+            if w in self._idle:
+                self._idle.remove(w)
+            return w
+        return None
 
     async def rpc_return_lease(self, lease_id: str, kill_worker: bool = False):
         lease = self._leases.pop(lease_id, None)

@@ -40,28 +40,47 @@ def fast_python_cmd(module: str, argv: List[str] = ()) -> Tuple[List[str], Dict[
     return [sys.executable, "-S", "-m", module, *argv], env
 
 
-class _JaxSiteHook:
-    """Meta-path hook: the first `import jax` triggers sitecustomize
-    (TPU PJRT plugin registration) before jax loads.  Workers that never
-    touch jax never pay the ~2s registration cost; a fleet of fresh
-    workers importing jax eagerly would saturate the host's cores."""
-
-    def find_spec(self, name, path=None, target=None):
-        if name == "jax" or name.startswith("jax."):
-            import sys
-
-            try:
-                sys.meta_path.remove(self)
-            except ValueError:
-                return None
-            try:
-                import sitecustomize  # noqa: F401
-            except ImportError:
-                pass
-        return None
-
-
 def install_jax_site_hook() -> None:
+    """Make the first `import jax` trigger sitecustomize (TPU PJRT plugin
+    registration) before jax loads.  Workers that never touch jax never
+    pay the ~2s registration cost; a fleet of fresh workers importing jax
+    eagerly would saturate the host's cores.
+
+    Implemented by wrapping builtins.__import__ rather than a meta-path
+    finder: a finder that imports jax as a side effect trips CPython's
+    `_find_spec` sys.modules re-check, which re-executes jax/__init__
+    into a fresh module and corrupts its deprecation registry.
+    __import__ short-circuits on sys.modules, so after sitecustomize has
+    fully imported jax the original import proceeds without re-execution.
+    """
+    import builtins
+    import importlib
     import sys
 
-    sys.meta_path.insert(0, _JaxSiteHook())
+    orig_import = builtins.__import__
+    orig_import_module = importlib.import_module
+
+    def _maybe_load_site(name: str) -> None:
+        if (name == "jax" or name.startswith("jax.")) and "jax" not in sys.modules:
+            builtins.__import__ = orig_import
+            importlib.import_module = orig_import_module
+            import os
+
+            # an explicit cpu platform (tests' virtual meshes) must not
+            # pull in the TPU plugin
+            if os.environ.get("JAX_PLATFORMS") != "cpu":
+                try:
+                    import sitecustomize  # noqa: F401
+                except ImportError:
+                    pass
+
+    def hooked_import(name, *args, **kwargs):
+        _maybe_load_site(name)
+        return orig_import(name, *args, **kwargs)
+
+    def hooked_import_module(name, package=None):
+        _maybe_load_site(name)
+        return orig_import_module(name, package)
+
+    builtins.__import__ = hooked_import
+    importlib.import_module = hooked_import_module

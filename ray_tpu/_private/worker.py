@@ -84,13 +84,14 @@ class _ExecState(threading.local):
 
 class _TaskState:
     __slots__ = ("spec", "contained_refs", "retries_left", "sched_key",
-                 "return_oids")
+                 "return_oids", "deps_ready")
 
     def __init__(self, spec: TaskSpec, contained_refs: List[ObjectRef]):
         self.spec = spec
         self.contained_refs = contained_refs
         self.retries_left = spec.max_retries
         self.sched_key = spec.scheduling_class()
+        self.deps_ready = True
         self.return_oids = [
             ObjectID.from_index(TaskID.from_hex(spec.task_id), i + 1).hex()
             for i in range(spec.num_returns)
@@ -465,17 +466,8 @@ class CoreWorker(RpcHost):
         while True:
             still = []
             for ref in pending:
-                if self.memory.ready(ref.oid):
+                if self.memory.ready(ref.oid) or self._ref_ready_elsewhere(ref):
                     ready.append(ref)
-                elif ref.node_addr is not None or not self.memory.known(ref.oid):
-                    # plasma-path object: ask the local store
-                    try:
-                        if self.plasma.contains(ref.oid):
-                            ready.append(ref)
-                        else:
-                            still.append(ref)
-                    except Exception:
-                        still.append(ref)
                 else:
                     still.append(ref)
             pending = still
@@ -484,6 +476,27 @@ class CoreWorker(RpcHost):
             if deadline is not None and time.monotonic() >= deadline:
                 return ready, pending
             time.sleep(0.005)
+
+    def _ref_ready_elsewhere(self, ref: ObjectRef) -> bool:
+        """Readiness probe for refs this process doesn't own: the local
+        plasma store first, then the owner (covers values inlined in the
+        owner's memory store, which never touch plasma)."""
+        if self.memory.known(ref.oid):
+            return False  # locally owned and still pending
+        try:
+            if self.plasma.contains(ref.oid):
+                return True
+        except Exception:
+            pass
+        owner = ref.owner_addr
+        if owner is None or tuple(owner) == self.address:
+            return False
+        try:
+            r = self._io.run(self._afetch_from_owner(tuple(owner), ref.oid, 0.0),
+                             timeout=15.0)
+        except Exception:
+            return False
+        return any(k in r for k in ("inline", "plasma", "error", "freed"))
 
     # ---------------------------------------------------------- task submit
 
@@ -778,10 +791,20 @@ class CoreWorker(RpcHost):
             return
         task.spec.seqno = astate.seq
         astate.seq += 1
+        # enqueue BEFORE resolving deps so per-handle submission order is
+        # preserved even when an earlier call waits on a pending ref
+        # (reference: direct_actor_task_submitter.h sequence numbers)
+        task.deps_ready = False
+        astate.pending.append(task)
         ok = await self._resolve_deps(task)
         if not ok:
+            try:
+                astate.pending.remove(task)
+            except ValueError:
+                pass
+            await self._actor_pump(astate)  # unblock the queue behind it
             return
-        astate.pending.append(task)
+        task.deps_ready = True
         await self._actor_pump(astate)
 
     async def _actor_pump(self, astate: _ActorState):
@@ -791,7 +814,8 @@ class CoreWorker(RpcHost):
             await self._actor_resolve(astate)
             if astate.dead or astate.recovering:
                 return
-        while astate.pending and len(astate.inflight) < _MAX_ACTOR_INFLIGHT:
+        while astate.pending and astate.pending[0].deps_ready \
+                and len(astate.inflight) < _MAX_ACTOR_INFLIGHT:
             task = astate.pending.popleft()
             astate.inflight[task.spec.seqno] = task
             self._spawn(self._actor_push(astate, task, astate.instance))

@@ -170,43 +170,42 @@ class RemoteFunction:
     """Handle produced by @remote on a function
     (reference: python/ray/remote_function.py)."""
 
-    def __init__(self, fn, *, num_returns: int = 1,
-                 num_cpus: Optional[float] = None,
-                 num_gpus: Optional[float] = None,
-                 num_tpus: Optional[float] = None,
-                 resources: Optional[Dict[str, float]] = None,
-                 max_retries: int = 3, name: str = ""):
+    _OPT_KEYS = ("num_returns", "num_cpus", "num_gpus", "num_tpus",
+                 "resources", "max_retries", "name")
+
+    def __init__(self, fn, **opts):
+        bad = set(opts) - set(self._OPT_KEYS)
+        if bad:
+            raise TypeError(f"unknown @remote option(s): {sorted(bad)}")
         self._fn = fn
-        self._num_returns = num_returns
-        self._resources = _build_resources(num_cpus, num_gpus, num_tpus,
-                                           resources, default_cpu=1)
-        self._max_retries = max_retries
-        self._name = name or getattr(fn, "__qualname__", getattr(fn, "__name__", "fn"))
-        self._function_id: Optional[str] = None
+        self._opts = opts
+        self._num_returns = opts.get("num_returns") or 1
+        self._resources = _build_resources(
+            opts.get("num_cpus"), opts.get("num_gpus"), opts.get("num_tpus"),
+            opts.get("resources"), default_cpu=1)
+        self._max_retries = opts.get("max_retries", 3)
+        self._name = opts.get("name") or getattr(
+            fn, "__qualname__", getattr(fn, "__name__", "fn"))
+        # (cluster worker_id -> function table id): the table is per-head,
+        # so a new init() after shutdown() must re-export
+        self._function_ids: Dict[str, str] = {}
         self.__doc__ = getattr(fn, "__doc__", None)
 
     def options(self, **opts) -> "RemoteFunction":
-        merged = dict(
-            num_returns=opts.get("num_returns", self._num_returns),
-            num_cpus=opts.get("num_cpus"),
-            num_gpus=opts.get("num_gpus"),
-            num_tpus=opts.get("num_tpus"),
-            resources=opts.get("resources"),
-            max_retries=opts.get("max_retries", self._max_retries),
-            name=opts.get("name", self._name),
-        )
-        rf = RemoteFunction(self._fn, **merged)
-        if not any(opts.get(k) is not None
-                   for k in ("num_cpus", "num_gpus", "num_tpus", "resources")):
-            rf._resources = self._resources
-        return rf
+        """New handle with the given options overriding, others inherited."""
+        return RemoteFunction(self._fn, **{**self._opts, **opts})
+
+    def _fid(self, w) -> str:
+        fid = self._function_ids.get(w.worker_id)
+        if fid is None:
+            fid = w.functions.export(self._fn)
+            self._function_ids = {w.worker_id: fid}
+        return fid
 
     def remote(self, *args, **kwargs):
         w = _worker()
-        if self._function_id is None:
-            self._function_id = w.functions.export(self._fn)
         refs = w.submit_task(
-            self._function_id, args, kwargs, num_returns=self._num_returns,
+            self._fid(w), args, kwargs, num_returns=self._num_returns,
             resources=self._resources, max_retries=self._max_retries,
             name=self._name)
         if self._num_returns == 1:
@@ -306,48 +305,42 @@ class ActorHandle:
 
 
 class ActorClass:
-    def __init__(self, cls, *, num_cpus=None, num_gpus=None, num_tpus=None,
-                 resources=None, max_restarts: int = 0,
-                 max_task_retries: int = 0, max_concurrency: int = 1,
-                 name: str = "", lifetime: str = ""):
+    _OPT_KEYS = ("num_cpus", "num_gpus", "num_tpus", "resources",
+                 "max_restarts", "max_task_retries", "max_concurrency",
+                 "name", "lifetime")
+
+    def __init__(self, cls, **opts):
+        bad = set(opts) - set(self._OPT_KEYS)
+        if bad:
+            raise TypeError(f"unknown actor option(s): {sorted(bad)}")
         self._cls = cls
+        self._opts = opts
         # actors hold 0 CPUs while alive unless explicitly requested
         # (reference: ray actor default num_cpus=0 post-creation, so many
         # actors coexist on few cores)
-        self._resources = _build_resources(num_cpus, num_gpus, num_tpus,
-                                           resources, default_cpu=0)
-        self._max_restarts = max_restarts
-        self._max_task_retries = max_task_retries
-        self._max_concurrency = max_concurrency
-        self._name = name
-        self._lifetime = lifetime
-        self._class_id: Optional[str] = None
+        self._resources = _build_resources(
+            opts.get("num_cpus"), opts.get("num_gpus"), opts.get("num_tpus"),
+            opts.get("resources"), default_cpu=0)
+        self._max_restarts = opts.get("max_restarts", 0)
+        self._max_task_retries = opts.get("max_task_retries", 0)
+        self._max_concurrency = opts.get("max_concurrency", 1)
+        self._name = opts.get("name", "")
+        self._lifetime = opts.get("lifetime", "")
+        self._class_ids: Dict[str, str] = {}
         self.__doc__ = getattr(cls, "__doc__", None)
 
     def options(self, **opts) -> "ActorClass":
-        ac = ActorClass(
-            self._cls,
-            num_cpus=opts.get("num_cpus"),
-            num_gpus=opts.get("num_gpus"),
-            num_tpus=opts.get("num_tpus"),
-            resources=opts.get("resources"),
-            max_restarts=opts.get("max_restarts", self._max_restarts),
-            max_task_retries=opts.get("max_task_retries", self._max_task_retries),
-            max_concurrency=opts.get("max_concurrency", self._max_concurrency),
-            name=opts.get("name", self._name),
-            lifetime=opts.get("lifetime", ""),
-        )
-        if not any(opts.get(k) is not None
-                   for k in ("num_cpus", "num_gpus", "num_tpus", "resources")):
-            ac._resources = self._resources
-        return ac
+        """New handle with the given options overriding, others inherited."""
+        return ActorClass(self._cls, **{**self._opts, **opts})
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         w = _worker()
-        if self._class_id is None:
-            self._class_id = w.functions.export(self._cls)
+        cid = self._class_ids.get(w.worker_id)
+        if cid is None:
+            cid = w.functions.export(self._cls)
+            self._class_ids = {w.worker_id: cid}
         actor_id = w.create_actor(
-            self._class_id, args, kwargs, resources=self._resources,
+            cid, args, kwargs, resources=self._resources,
             max_restarts=self._max_restarts,
             max_task_retries=self._max_task_retries,
             max_concurrency=self._max_concurrency, name=self._name)
@@ -368,15 +361,8 @@ def remote(*args, **kwargs):
 
     def make(target):
         if isinstance(target, type):
-            cls_opts = {k: v for k, v in kwargs.items()
-                        if k in ("num_cpus", "num_gpus", "num_tpus", "resources",
-                                 "max_restarts", "max_task_retries",
-                                 "max_concurrency", "name", "lifetime")}
-            return ActorClass(target, **cls_opts)
-        fn_opts = {k: v for k, v in kwargs.items()
-                   if k in ("num_returns", "num_cpus", "num_gpus", "num_tpus",
-                            "resources", "max_retries", "name")}
-        return RemoteFunction(target, **fn_opts)
+            return ActorClass(target, **kwargs)
+        return RemoteFunction(target, **kwargs)
 
     if len(args) == 1 and not kwargs and (callable(args[0]) or isinstance(args[0], type)):
         return make(args[0])

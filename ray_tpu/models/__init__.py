@@ -1,0 +1,5 @@
+"""Model zoo for the TPU-native stack (flagship: Llama-family decoder)."""
+
+from ray_tpu.models.llama import LlamaConfig, LlamaModel, llama_param_rules
+
+__all__ = ["LlamaConfig", "LlamaModel", "llama_param_rules"]

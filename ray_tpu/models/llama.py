@@ -1,0 +1,212 @@
+"""Llama-family decoder-only transformer, TPU-first.
+
+This is the flagship model for the framework's north-star path
+(BASELINE.json config #2: Llama-3-8B FSDP/GSPMD on a v5e pod slice).
+The reference has no model code of its own — Train wraps user torch
+models (reference: python/ray/train/torch/train_loop_utils.py) — so this
+is green-field, designed for the MXU and GSPMD from the start:
+
+  - bfloat16 activations/compute, fp32 params + optimizer state
+  - GQA attention with rotary embeddings; attention runs through a
+    pluggable kernel hook so the Pallas flash/ring kernels (ray_tpu/ops)
+    swap in without touching the model
+  - static shapes everywhere; no data-dependent Python control flow, so
+    one jit trace covers the whole step
+  - `llama_param_rules` gives PartitionSpecs for tp (heads / mlp hidden)
+    and fsdp (everything else) so the same module runs 1-chip or pod
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    hidden_dim: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def tiny(cls) -> "LlamaConfig":
+        """Test-size config: compiles in seconds on CPU."""
+        return cls(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                   n_kv_heads=2, hidden_dim=128, max_seq_len=128)
+
+    @classmethod
+    def small(cls) -> "LlamaConfig":
+        """~110M params: single-chip bench size."""
+        return cls(vocab_size=32000, dim=768, n_layers=12, n_heads=12,
+                   n_kv_heads=4, hidden_dim=2048, max_seq_len=2048)
+
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        return cls(vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
+                   n_kv_heads=8, hidden_dim=14336, max_seq_len=8192)
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def num_params(self) -> int:
+        embed = self.vocab_size * self.dim
+        per_layer = (
+            self.dim * self.n_heads * self.head_dim          # wq
+            + 2 * self.dim * self.n_kv_heads * self.head_dim  # wk, wv
+            + self.n_heads * self.head_dim * self.dim         # wo
+            + 3 * self.dim * self.hidden_dim                  # w1, w2, w3
+            + 2 * self.dim                                    # norms
+        )
+        return embed * 2 + per_layer * self.n_layers + self.dim
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding over the last dim. x: [B, S, H, D]."""
+    half = x.shape[-1] // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def default_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      causal: bool = True) -> jax.Array:
+    """Reference attention path: XLA fuses this well on its own; the
+    Pallas flash kernel (ray_tpu/ops/flash_attention.py) replaces it for
+    long sequences. q: [B,S,H,D], k/v: [B,S,Hkv,D]."""
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    group = h // hkv
+    q = q.reshape(b, s, hkv, group, d)
+    logits = jnp.einsum("bshgd,bthd->bhgst", q, k).astype(jnp.float32)
+    logits = logits / jnp.sqrt(d).astype(jnp.float32)
+    if causal:
+        mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+        logits = jnp.where(mask[None, None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhgst,bthd->bshgd", probs, v)
+    return out.reshape(b, s, h, d)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = x.astype(jnp.float32) * jax.lax.rsqrt(var + self.eps)
+        return (out * scale).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+    kernel: Optional[Callable] = None  # pluggable (flash/ring) attention
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=jnp.float32)
+        q = dense(features=(cfg.n_heads, cfg.head_dim), name="wq")(x)
+        k = dense(features=(cfg.n_kv_heads, cfg.head_dim), name="wk")(x)
+        v = dense(features=(cfg.n_kv_heads, cfg.head_dim), name="wv")(x)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        attend = self.kernel or default_attention
+        out = attend(q, k, v)
+        return nn.DenseGeneral(features=cfg.dim, axis=(-2, -1), use_bias=False,
+                               dtype=cfg.dtype, param_dtype=jnp.float32,
+                               name="wo")(out)
+
+
+class Mlp(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=jnp.float32)
+        gate = dense(cfg.hidden_dim, name="w1")(x)
+        up = dense(cfg.hidden_dim, name="w3")(x)
+        return dense(cfg.dim, name="w2")(nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    cfg: LlamaConfig
+    kernel: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        x = x + Attention(self.cfg, self.kernel, name="attn")(
+            RMSNorm(self.cfg.norm_eps, name="attn_norm")(x), positions)
+        x = x + Mlp(self.cfg, name="mlp")(
+            RMSNorm(self.cfg.norm_eps, name="mlp_norm")(x))
+        return x
+
+
+class LlamaModel(nn.Module):
+    cfg: LlamaConfig
+    kernel: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="embed")(tokens)
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1]), tokens.shape)
+        for i in range(cfg.n_layers):
+            x = Block(cfg, self.kernel, name=f"layer_{i}")(x, positions)
+        x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                          param_dtype=jnp.float32, name="lm_head")(x)
+        return logits
+
+
+def llama_param_rules() -> Dict[str, Any]:
+    """PartitionSpec rules by parameter-path substring.
+
+    tp shards head and mlp-hidden dims; fsdp shards the other big dim.
+    Same layout family as the scaling-book Llama recipe.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "embed": P("tp", "fsdp"),
+        "wq/kernel": P("fsdp", "tp", None),
+        "wk/kernel": P("fsdp", "tp", None),
+        "wv/kernel": P("fsdp", "tp", None),
+        "wo/kernel": P("tp", None, "fsdp"),
+        "w1/kernel": P("fsdp", "tp"),
+        "w3/kernel": P("fsdp", "tp"),
+        "w2/kernel": P("tp", "fsdp"),
+        "lm_head": P("fsdp", "tp"),
+        "norm": P(None),
+    }
+
+
+def causal_lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Next-token cross entropy with shifted targets."""
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)

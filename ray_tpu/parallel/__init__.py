@@ -1,0 +1,14 @@
+"""Parallelism primitives: device meshes, sharding specs, collectives.
+
+TPU-native replacement for the reference's NCCL/process-group layer
+(reference: python/ray/util/collective/, python/ray/train/torch/config.py
+_setup_torch_process_group): instead of NCCL rings bootstrapped over RPC,
+parallelism is expressed as a `jax.sharding.Mesh` with named axes and
+GSPMD shardings; XLA inserts the collectives over ICI/DCN.
+"""
+
+from ray_tpu.parallel.mesh import (MeshSpec, make_mesh, mesh_axes_for,
+                                   shard_batch, shard_params)
+
+__all__ = ["MeshSpec", "make_mesh", "mesh_axes_for", "shard_batch",
+           "shard_params"]

@@ -1,0 +1,19 @@
+"""ray_tpu.train: distributed training on TPU meshes via actor gangs.
+
+Equivalent of Ray Train (reference: python/ray/train/ —
+DataParallelTrainer data_parallel_trainer.py:22, BackendExecutor
+_internal/backend_executor.py:65, session _internal/session.py:109), with
+the torch process-group layer replaced by `jax.distributed` + GSPMD
+meshes: parallelism is declared as a MeshSpec (dp/fsdp/tp/sp/pp) instead
+of wrapping modules in DDP/FSDP.
+"""
+
+from ray_tpu.train.session import (TrainContext, get_context, report,
+                                   get_checkpoint)
+from ray_tpu.train.trainer import (JaxTrainer, Result, RunConfig,
+                                   ScalingConfig, TrainingFailedError)
+from ray_tpu.train.worker_group import WorkerGroup
+
+__all__ = ["JaxTrainer", "ScalingConfig", "RunConfig", "Result",
+           "TrainingFailedError", "WorkerGroup", "TrainContext",
+           "get_context", "report", "get_checkpoint"]

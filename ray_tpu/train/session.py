@@ -1,0 +1,87 @@
+"""Per-worker training session: rank context + report channel.
+
+Equivalent of the reference's _TrainSession
+(reference: python/ray/train/_internal/session.py:109 — report :401,
+public train.report :661, context accessors python/ray/train/context.py).
+The user's train loop runs in a thread inside the TrainWorker actor;
+`report(metrics, checkpoint=...)` enqueues results that the driver-side
+trainer drains via actor polls.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class TrainContext:
+    rank: int = 0
+    world_size: int = 1
+    local_rank: int = 0
+    node_rank: int = 0
+    experiment_name: str = ""
+    trial_dir: str = ""
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.rank
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+
+class _Session:
+    def __init__(self, ctx: TrainContext,
+                 checkpoint_to_restore: Optional[str] = None):
+        self.ctx = ctx
+        self.lock = threading.Lock()
+        self.reports: List[Dict[str, Any]] = []
+        self.checkpoint_to_restore = checkpoint_to_restore
+        self.finished = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.final: Any = None
+
+    def report(self, metrics: Dict[str, Any], checkpoint: Optional[str]):
+        with self.lock:
+            self.reports.append({"metrics": dict(metrics),
+                                 "checkpoint": checkpoint})
+
+    def drain(self) -> List[Dict[str, Any]]:
+        with self.lock:
+            out, self.reports = self.reports, []
+            return out
+
+
+_current: Optional[_Session] = None
+
+
+def _set_session(s: Optional[_Session]):
+    global _current
+    _current = s
+
+
+def _get_session() -> _Session:
+    if _current is None:
+        raise RuntimeError(
+            "No training session: report()/get_context() must be called "
+            "from inside a train loop launched by JaxTrainer")
+    return _current
+
+
+def get_context() -> TrainContext:
+    return _get_session().ctx
+
+
+def report(metrics: Dict[str, Any], checkpoint: Optional[str] = None) -> None:
+    """Report metrics (and optionally a checkpoint directory) from a
+    training worker (reference: train.report, session.py:661)."""
+    _get_session().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[str]:
+    """Checkpoint directory to restore from, when resuming."""
+    return _get_session().checkpoint_to_restore

@@ -4,17 +4,40 @@ Multi-device tests run on a virtual 8-device CPU mesh
 (reference test strategy: SURVEY.md §4.3 — JAX CPU
 ``xla_force_host_platform_device_count`` emulates multi-device meshes
 without hardware; the driver dry-runs the real multi-chip path).
+
+This host's sitecustomize registers the axon TPU backend at interpreter
+start; `jax.config.update("jax_platforms", "cpu")` overrides it for the
+test process, and the forced JAX_PLATFORMS=cpu env makes spawned workers
+skip the TPU plugin entirely (see spawn.install_jax_site_hook).
 """
 
 import os
 
-# Must be set before jax is imported anywhere in the test process tree.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Env for spawned daemons/workers (inherited): pure-CPU jax with a
+# virtual 8-device mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import pytest  # noqa: E402
+
+
+def force_cpu_jax():
+    """In-process override: this interpreter may already have the TPU
+    plugin registered (sitecustomize); select CPU before first use."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    return jax
+
+
+@pytest.fixture(scope="session")
+def cpu_jax():
+    return force_cpu_jax()
 
 
 @pytest.fixture
