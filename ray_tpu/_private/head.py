@@ -149,7 +149,29 @@ class HeadService(RpcHost):
         if _conn is not None:
             self._node_conns[_conn] = node_id
         self._cluster_version += 1
-        return {"ok": True, "cluster": self._cluster_view()}
+        self._broadcast_cluster_view()
+        return {"ok": True, "cluster": self._cluster_view(),
+                "version": self._cluster_version}
+
+    def _broadcast_cluster_view(self):
+        """Membership changed: push the fresh view to every agent so
+        feasibility checks don't wait out a heartbeat period (equivalent
+        of the reference's ray_syncer broadcast).  One task per peer so a
+        wedged agent can't stall the others."""
+        view = self._cluster_view()
+        version = self._cluster_version
+
+        async def _push_one(conn):
+            try:
+                await asyncio.wait_for(
+                    conn.push("cluster_update",
+                              {"cluster": view, "version": version}),
+                    timeout=5.0)
+            except Exception:
+                pass
+
+        for conn in list(self._node_conns):
+            asyncio.ensure_future(_push_one(conn))
 
     async def rpc_heartbeat(self, node_id: str, available: Dict[str, float]):
         entry = self.nodes.get(node_id)
@@ -194,6 +216,7 @@ class HeadService(RpcHost):
         if entry is None:
             return
         self._cluster_version += 1
+        self._broadcast_cluster_view()
         if entry.client is not None:
             await entry.client.close()
         # restart or fail every actor that lived on that node

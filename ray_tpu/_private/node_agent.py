@@ -81,6 +81,7 @@ class NodeAgent(RpcHost):
         self.resources = NodeResources(ResourceSet(resources))
         self.local = LocalScheduler(self.resources)
         self.cluster_view: Dict[str, Any] = {}
+        self._cluster_view_version = -1
         self._server: Optional[RpcServer] = None
         self.port = 0
         self.host = "127.0.0.1"
@@ -104,13 +105,14 @@ class NodeAgent(RpcHost):
         self.host = host
         self._server = RpcServer(self, host, port)
         self.port = await self._server.start()
-        self._head = RpcClient(self.head_addr[0], self.head_addr[1], label="head")
+        self._head = RpcClient(self.head_addr[0], self.head_addr[1], label="head",
+                               on_push=self._on_head_push)
         reply = await self._head.call(
             "register_node", node_id=self.node_id, host=self.host,
             port=self.port, arena_path=self.arena_path,
             resources=self.resources.total.to_dict(),
             is_head_node=self.is_head_node)
-        self.cluster_view = reply.get("cluster", {})
+        self._apply_cluster_view(reply.get("cluster"), reply.get("version"))
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
         for _ in range(config.worker_pool_prestart_workers):
@@ -145,6 +147,21 @@ class NodeAgent(RpcHost):
     async def wait_for_shutdown(self):
         await self._shutdown.wait()
 
+    def _apply_cluster_view(self, view, version) -> None:
+        """Last-write-wins would let an older RPC-reply snapshot clobber a
+        fresher pushed view; only apply monotonically newer versions."""
+        if view is None:
+            return
+        if version is None:
+            version = self._cluster_view_version  # legacy: accept equal
+        if version >= self._cluster_view_version:
+            self.cluster_view = view
+            self._cluster_view_version = version
+
+    def _on_head_push(self, method: str, payload):
+        if method == "cluster_update":
+            self._apply_cluster_view(payload.get("cluster"), payload.get("version"))
+
     async def _heartbeat_loop(self):
         period = config.gcs_health_check_period_ms / 1000.0
         while True:
@@ -152,8 +169,7 @@ class NodeAgent(RpcHost):
                 reply = await self._head.call(
                     "heartbeat", node_id=self.node_id,
                     available=self.resources.available.to_dict())
-                if "cluster" in reply:
-                    self.cluster_view = reply["cluster"]
+                self._apply_cluster_view(reply.get("cluster"), reply.get("version"))
             except Exception:
                 pass
             await asyncio.sleep(period)
@@ -298,13 +314,13 @@ class NodeAgent(RpcHost):
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
         out = open(os.path.join(log_dir, f"worker-{worker_id[:12]}.log"), "ab")
-        from ray_tpu._private.spawn import fast_python_cmd
+        from ray_tpu._private.spawn import fast_python_cmd, set_pdeathsig
 
         cmd, env_up = fast_python_cmd("ray_tpu._private.worker_main")
         env.update(env_up)
         proc = subprocess.Popen(
             cmd, env=env, stdout=out, stderr=subprocess.STDOUT,
-            start_new_session=True)
+            start_new_session=True, preexec_fn=set_pdeathsig)
         out.close()
         w = _Worker(worker_id, proc)
         self._workers[worker_id] = w

@@ -19,6 +19,27 @@ import sys
 from typing import Dict, List, Tuple
 
 
+# Pre-bound at import time: preexec_fn runs between fork and exec, where
+# imports/dlopen may deadlock if another thread held a lock at fork.
+_PR_SET_PDEATHSIG = 1
+_SIGKILL = 9
+try:
+    import ctypes as _ctypes
+
+    _libc = _ctypes.CDLL("libc.so.6", use_errno=True)
+except Exception:
+    _libc = None
+
+
+def set_pdeathsig():
+    """preexec_fn: deliver SIGKILL to the child when its parent dies, so
+    killing a node agent takes its workers down with it (real node-death
+    semantics for fault-injection tests; Linux only).  Only calls the
+    pre-bound libc.prctl — no imports or allocation post-fork."""
+    if _libc is not None:
+        _libc.prctl(_PR_SET_PDEATHSIG, _SIGKILL)
+
+
 def fast_python_cmd(module: str, argv: List[str] = ()) -> Tuple[List[str], Dict[str, str]]:
     """Returns (cmd, env_updates) to run `python -m module` without site."""
     paths: List[str] = []
