@@ -40,6 +40,40 @@ from ray_tpu._private.task_spec import TaskSpec
 # Actor states (reference: rpc::ActorTableData::ActorState)
 PENDING, ALIVE, RESTARTING, DEAD = "PENDING", "ALIVE", "RESTARTING", "DEAD"
 
+# Placement group states (reference: gcs_placement_group_manager.h)
+PG_PENDING, PG_CREATED, PG_REMOVED = "PENDING", "CREATED", "REMOVED"
+
+
+class _PgEntry:
+    __slots__ = ("pg_id", "bundles", "strategy", "state", "placements",
+                 "name", "waiters", "failure")
+
+    def __init__(self, pg_id: str, bundles: List[Dict[str, float]],
+                 strategy: str, name: str):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.state = PG_PENDING
+        self.placements: List[Optional[str]] = [None] * len(bundles)  # node ids
+        self.name = name
+        self.waiters: List[asyncio.Event] = []
+        self.failure = ""
+
+    def info(self, nodes: Dict[str, "_NodeEntry"]) -> Dict[str, Any]:
+        placements = []
+        for nid in self.placements:
+            node = nodes.get(nid) if nid else None
+            placements.append(
+                {"node_id": nid, "addr": [node.host, node.port]} if node else None)
+        return {"pg_id": self.pg_id, "state": self.state,
+                "strategy": self.strategy, "bundles": self.bundles,
+                "placements": placements, "failure": self.failure}
+
+    def wake(self):
+        for ev in self.waiters:
+            ev.set()
+        self.waiters.clear()
+
 
 class _ActorEntry:
     __slots__ = ("actor_id", "spec_wire", "state", "node_id", "worker_id",
@@ -110,6 +144,7 @@ class HeadService(RpcHost):
         self.kv: Dict[str, bytes] = {}
         self.actors: Dict[str, _ActorEntry] = {}
         self.named_actors: Dict[str, str] = {}  # name -> actor_id
+        self.placement_groups: Dict[str, _PgEntry] = {}
         self._job_counter = itertools.count(1)
         self._server: Optional[RpcServer] = None
         self._health_task: Optional[asyncio.Task] = None
@@ -224,6 +259,7 @@ class HeadService(RpcHost):
             if actor.node_id == node_id and actor.state in (ALIVE, PENDING, RESTARTING):
                 await self._on_actor_worker_lost(
                     actor, f"node {node_id[:8]} died: {reason}")
+        await self._on_pg_node_dead(node_id)
 
     # ---- internal KV (function table rides on this) ------------------------
 
@@ -348,11 +384,42 @@ class HeadService(RpcHost):
         ts = TaskSpec.from_wire(actor.spec_wire)
         demand = ts.resource_set()
         delay = 0.05
+        if ts.placement_group_id:
+            # waiting for the group to be placed must not consume the
+            # creation retry budget — PGs may stay PENDING for a while
+            while True:
+                if actor.kill_requested or actor.state == DEAD:
+                    return
+                pg = self.placement_groups.get(ts.placement_group_id)
+                if pg is None:
+                    actor.state = DEAD
+                    actor.death_cause = "placement group removed"
+                    actor.wake()
+                    return
+                if max(ts.bundle_index, 0) >= len(pg.bundles):
+                    actor.state = DEAD
+                    actor.death_cause = (
+                        f"bundle index {ts.bundle_index} out of range for "
+                        f"{len(pg.bundles)}-bundle placement group")
+                    actor.wake()
+                    return
+                if pg.state == PG_CREATED:
+                    break
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 1.0)
         for attempt in range(config.actor_creation_retries + 1):
             if actor.kill_requested or actor.state == DEAD:
                 return
-            cluster = {nid: n.resources for nid, n in self.nodes.items()}
-            nid = pick_node(cluster, demand, local_node_id="")
+            if ts.placement_group_id:
+                pg = self.placement_groups.get(ts.placement_group_id)
+                if pg is None or pg.state != PG_CREATED:
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, 2.0)
+                    continue
+                nid = pg.placements[max(ts.bundle_index, 0)]
+            else:
+                cluster = {nid: n.resources for nid, n in self.nodes.items()}
+                nid = pick_node(cluster, demand, local_node_id="")
             if nid is None:
                 await asyncio.sleep(delay)
                 delay = min(delay * 2, 2.0)
@@ -432,6 +499,177 @@ class HeadService(RpcHost):
         if node.client is None or not node.client.connected:
             node.client = RpcClient(node.host, node.port, label=f"agent-{node.node_id[:8]}")
         return node.client
+
+    # ---- placement groups --------------------------------------------------
+
+    async def rpc_create_placement_group(self, bundles: List[Dict[str, float]],
+                                         strategy: str = "PACK",
+                                         name: str = ""):
+        from ray_tpu._private.ids import PlacementGroupID
+
+        pg_id = PlacementGroupID.from_random().hex()
+        entry = _PgEntry(pg_id, bundles, strategy, name)
+        self.placement_groups[pg_id] = entry
+        asyncio.ensure_future(self._schedule_pg(entry))
+        return {"pg_id": pg_id}
+
+    async def rpc_get_placement_group(self, pg_id: str, wait: bool = False,
+                                      wait_s: Optional[float] = None):
+        entry = self.placement_groups.get(pg_id)
+        if entry is None:
+            return {"state": PG_REMOVED, "failure": "no such placement group"}
+        poll = min(wait_s if wait_s is not None else 1e9,
+                   config.pubsub_poll_timeout_ms / 1000.0)
+        deadline = time.monotonic() + poll
+        while wait and entry.state == PG_PENDING and time.monotonic() < deadline:
+            ev = asyncio.Event()
+            entry.waiters.append(ev)
+            try:
+                await asyncio.wait_for(ev.wait(), deadline - time.monotonic())
+            except asyncio.TimeoutError:
+                break
+            finally:
+                if ev in entry.waiters:  # drop unfired waiters (leak guard)
+                    entry.waiters.remove(ev)
+        return entry.info(self.nodes)
+
+    async def rpc_remove_placement_group(self, pg_id: str):
+        entry = self.placement_groups.pop(pg_id, None)
+        if entry is None:
+            return {"ok": False}
+        entry.state = PG_REMOVED
+        entry.wake()
+        for idx, nid in enumerate(entry.placements):
+            node = self.nodes.get(nid) if nid else None
+            if node is not None:
+                try:
+                    await self._node_client(node).call(
+                        "return_bundle", pg_id=pg_id, bundle_index=idx)
+                except Exception:
+                    pass
+        return {"ok": True}
+
+    async def rpc_list_placement_groups(self):
+        return {"placement_groups": [
+            e.info(self.nodes) for e in self.placement_groups.values()]}
+
+    def _plan_pg(self, entry: _PgEntry) -> Optional[List[str]]:
+        """Choose a node per bundle per strategy, against a scratch copy of
+        the cluster view (all-or-nothing; reference:
+        bundle_scheduling_policy.h pack/spread/strict variants)."""
+        scratch: Dict[str, NodeResources] = {
+            nid: NodeResources.from_dict(
+                {"total": n.resources.total.to_dict(),
+                 "available": n.resources.available.to_dict()})
+            for nid, n in self.nodes.items()
+        }
+        plan: List[Optional[str]] = []
+        used_nodes: List[str] = []
+        for idx, bundle in enumerate(entry.bundles):
+            existing = entry.placements[idx]
+            if existing is not None and existing in scratch:
+                # bundle already reserved there (rescheduling after a node
+                # death replaces only the lost bundles)
+                plan.append(existing)
+                used_nodes.append(existing)
+                continue
+            demand = ResourceSet(bundle)
+            candidates = [(nid, nr) for nid, nr in scratch.items()
+                          if nr.can_fit(demand)]
+            if entry.strategy in ("STRICT_SPREAD",):
+                candidates = [(nid, nr) for nid, nr in candidates
+                              if nid not in used_nodes]
+            if not candidates:
+                return None
+            if entry.strategy in ("PACK", "STRICT_PACK") and used_nodes:
+                packed = [c for c in candidates if c[0] == used_nodes[-1]]
+                if packed:
+                    candidates = packed
+                elif entry.strategy == "STRICT_PACK":
+                    return None
+            if entry.strategy == "SPREAD":
+                # prefer nodes not already used, then least utilized
+                candidates.sort(key=lambda kv: (kv[0] in used_nodes,
+                                                kv[1].utilization()))
+            else:
+                candidates.sort(key=lambda kv: kv[1].utilization())
+            nid, nr = candidates[0]
+            nr.acquire(demand)
+            plan.append(nid)
+            used_nodes.append(nid)
+        return plan
+
+    async def _schedule_pg(self, entry: _PgEntry):
+        """Keep trying until reserved or removed.  Like the reference, a
+        group that doesn't currently fit stays PENDING indefinitely (the
+        autoscaler is what resolves persistent infeasibility)."""
+        delay = 0.05
+        while entry.state == PG_PENDING \
+                and self.placement_groups.get(entry.pg_id) is entry:
+            plan = self._plan_pg(entry)
+            if plan is not None:
+                ok = await self._reserve_pg(entry, plan)
+                if ok:
+                    if entry.state != PG_PENDING:  # removed while reserving
+                        for idx, nid in enumerate(plan):
+                            node = self.nodes.get(nid)
+                            if node is not None:
+                                try:
+                                    await self._node_client(node).call(
+                                        "return_bundle", pg_id=entry.pg_id,
+                                        bundle_index=idx)
+                                except Exception:
+                                    pass
+                        return
+                    entry.placements = plan
+                    entry.state = PG_CREATED
+                    entry.wake()
+                    return
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, 1.0)
+
+    async def _reserve_pg(self, entry: _PgEntry, plan: List[str]) -> bool:
+        """Reserve every bundle; roll back on any failure (all-or-nothing —
+        the TPU-slice gang atomicity guarantee)."""
+        newly_reserved: List[int] = []
+        for idx, nid in enumerate(plan):
+            node = self.nodes.get(nid)
+            if node is None:
+                break
+            try:
+                r = await self._node_client(node).call(
+                    "reserve_bundle", pg_id=entry.pg_id, bundle_index=idx,
+                    resources=entry.bundles[idx])
+            except Exception:
+                r = {"ok": False}
+            if not r.get("ok"):
+                break
+            if not r.get("already"):
+                # only bundles reserved by THIS attempt may be rolled
+                # back; pre-existing ones carry live workloads
+                newly_reserved.append(idx)
+        else:
+            return True
+        for idx in newly_reserved:
+            node = self.nodes.get(plan[idx])
+            if node is not None:
+                try:
+                    await self._node_client(node).call(
+                        "return_bundle", pg_id=entry.pg_id, bundle_index=idx)
+                except Exception:
+                    pass
+        return False
+
+    async def _on_pg_node_dead(self, node_id: str):
+        """Bundles on a dead node are re-reserved elsewhere (non-strict) or
+        the whole group goes back to PENDING."""
+        for entry in self.placement_groups.values():
+            if entry.state == PG_CREATED and node_id in entry.placements:
+                entry.state = PG_PENDING
+                for idx, nid in enumerate(entry.placements):
+                    if nid == node_id:
+                        entry.placements[idx] = None
+                asyncio.ensure_future(self._schedule_pg(entry))
 
     # ---- misc --------------------------------------------------------------
 

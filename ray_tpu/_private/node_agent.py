@@ -56,12 +56,14 @@ class _Worker:
 
 
 class _Lease:
-    __slots__ = ("lease_id", "worker", "resources")
+    __slots__ = ("lease_id", "worker", "resources", "bundle_key")
 
-    def __init__(self, lease_id: str, worker: _Worker, resources: ResourceSet):
+    def __init__(self, lease_id: str, worker: _Worker, resources: ResourceSet,
+                 bundle_key: str = ""):
         self.lease_id = lease_id
         self.worker = worker
         self.resources = resources
+        self.bundle_key = bundle_key
 
 
 class NodeAgent(RpcHost):
@@ -80,6 +82,10 @@ class NodeAgent(RpcHost):
         self.store = StoreCore(self.arena_path, self.capacity, spill_dir)
         self.resources = NodeResources(ResourceSet(resources))
         self.local = LocalScheduler(self.resources)
+        # placement-group bundles reserved on this node: "pgid:idx" ->
+        # LocalScheduler over the reserved resources (reference:
+        # src/ray/raylet/placement_group_resource_manager.h)
+        self._bundles: Dict[str, LocalScheduler] = {}
         self.cluster_view: Dict[str, Any] = {}
         self._cluster_view_version = -1
         self._server: Optional[RpcServer] = None
@@ -359,7 +365,7 @@ class NodeAgent(RpcHost):
         if w.lease_id is not None:
             lease = self._leases.pop(w.lease_id, None)
             if lease is not None:
-                for tok in self.local.release(lease.resources):
+                for tok in self._lease_sched(lease).release(lease.resources):
                     self._grant_token(tok)
         self.store.release_client(worker_id)
         if self._head is not None:
@@ -373,6 +379,53 @@ class NodeAgent(RpcHost):
         except Exception:
             pass
 
+    # ---- placement group bundles -------------------------------------------
+
+    async def rpc_reserve_bundle(self, pg_id: str, bundle_index: int,
+                                 resources: Dict[str, float]):
+        """Atomically carve a bundle's resources out of the node pool
+        (reference: node_manager.proto PrepareBundleResources)."""
+        key = f"{pg_id}:{bundle_index}"
+        if key in self._bundles:
+            return {"ok": True, "already": True}
+        demand = ResourceSet(resources)
+        if not self.resources.acquire(demand):
+            return {"ok": False, "error": "insufficient resources"}
+        self._bundles[key] = LocalScheduler(NodeResources(demand))
+        return {"ok": True}
+
+    async def rpc_return_bundle(self, pg_id: str, bundle_index: int):
+        key = f"{pg_id}:{bundle_index}"
+        sched = self._bundles.pop(key, None)
+        if sched is None:
+            return {"ok": False}
+        # wake queued lease requests; they re-check and see the bundle gone
+        queued = [token for token, _ in sched._queue]
+        sched._queue.clear()
+        for token in queued:
+            self._grant_token(token)
+        # kill leases still running against the bundle (reference: PG
+        # removal kills its tasks/actors)
+        for lease_id, lease in list(self._leases.items()):
+            if lease.bundle_key == key:
+                self._leases.pop(lease_id, None)
+                lease.worker.lease_id = None
+                try:
+                    lease.worker.proc.terminate()
+                except Exception:
+                    pass
+        for tok in self.local.release(sched.resources.total):
+            self._grant_token(tok)
+        return {"ok": True}
+
+    def _sched_for(self, ts: TaskSpec):
+        """(scheduler, bundle_key) for a task; bundle-targeted tasks draw
+        from their reserved bundle, not the free node pool."""
+        if ts.placement_group_id:
+            key = f"{ts.placement_group_id}:{max(ts.bundle_index, 0)}"
+            return self._bundles.get(key), key
+        return self.local, ""
+
     # ---- lease protocol ----------------------------------------------------
 
     async def rpc_request_lease(self, spec: Dict[str, Any], grant_only: bool = False):
@@ -384,6 +437,8 @@ class NodeAgent(RpcHost):
         """
         ts = TaskSpec.from_wire(spec)
         demand = ts.resource_set()
+        if ts.placement_group_id:
+            return await self._request_bundle_lease(ts, demand)
         if not grant_only:
             cluster = {
                 nid: NodeResources.from_dict(
@@ -407,58 +462,81 @@ class NodeAgent(RpcHost):
         if not self.resources.is_feasible(demand):
             return {"error": "infeasible",
                     "error_str": f"node cannot satisfy {demand.to_dict()}"}
-        if self.local.try_acquire(demand):
-            return await self._grant(demand)
+        return await self._acquire_and_grant(self.local, demand, "")
+
+    async def _request_bundle_lease(self, ts: TaskSpec, demand: ResourceSet):
+        sched, key = self._sched_for(ts)
+        if sched is None:
+            return {"error": "bundle not reserved",
+                    "error_str": f"bundle {key} is not on node "
+                                 f"{self.node_id[:12]}"}
+        if not sched.resources.is_feasible(demand):
+            return {"error": "infeasible",
+                    "error_str": f"demand {demand.to_dict()} exceeds bundle "
+                                 f"{key} capacity"}
+        return await self._acquire_and_grant(sched, demand, key)
+
+    async def _acquire_and_grant(self, sched: LocalScheduler,
+                                 demand: ResourceSet, bundle_key: str):
+        if sched.try_acquire(demand):
+            return await self._grant(sched, demand, bundle_key)
         # queue FIFO-with-resources
         token = object()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._lease_waiters[token] = (fut, demand)
-        self.local.enqueue(token, demand)
+        self._lease_waiters[token] = (fut, demand, sched)
+        sched.enqueue(token, demand)
         try:
             await asyncio.wait_for(fut, config.worker_lease_timeout_ms / 1000.0)
         except asyncio.TimeoutError:
-            found, granted = self.local.cancel(token)
+            found, granted = sched.cancel(token)
             self._lease_waiters.pop(token, None)
             for tok in granted:
                 self._grant_token(tok)
             if not found and fut.done() and not fut.cancelled():
+                if bundle_key and bundle_key not in self._bundles:
+                    # woken because the bundle was removed, not granted
+                    return {"error": "bundle not reserved",
+                            "error_str": "placement group removed while queued"}
                 # granted between timeout and cancel; resources are ours
-                return await self._grant(demand, already_acquired=True)
+                return await self._grant(sched, demand, bundle_key)
             # if not found and fut is cancelled, _grant_token already gave
             # the acquired resources back — nothing more to do here
             return {"error": "lease timeout",
                     "error_str": "timed out waiting for resources"}
-        return await self._grant(demand, already_acquired=True)
+        if bundle_key and bundle_key not in self._bundles:
+            return {"error": "bundle not reserved",
+                    "error_str": "placement group removed while queued"}
+        return await self._grant(sched, demand, bundle_key)
 
     def _grant_token(self, token: object):
         entry = self._lease_waiters.pop(token, None)
         if entry is None:
             return
-        fut, demand = entry
+        fut, demand, sched = entry
         if not fut.done():
             fut.set_result(True)
         else:
             # waiter gave up after the queue acquired on its behalf
-            for tok in self.local.release(demand):
+            for tok in sched.release(demand):
                 self._grant_token(tok)
 
     def _drain_lease_queue(self):
-        for tok in self.local.drain():
-            self._grant_token(tok)
+        for sched in [self.local, *self._bundles.values()]:
+            for tok in sched.drain():
+                self._grant_token(tok)
 
-    async def _grant(self, demand: ResourceSet, already_acquired: bool = False):
-        # `demand` resources are held; find or spawn a worker
-        if not already_acquired:
-            pass  # try_acquire already took them
+    async def _grant(self, sched: LocalScheduler, demand: ResourceSet,
+                     bundle_key: str = ""):
+        # `demand` resources are already acquired from `sched`
         worker = await self._pop_worker()
         if worker is None:
-            for tok in self.local.release(demand):
+            for tok in sched.release(demand):
                 self._grant_token(tok)
             return {"error": "worker spawn failed",
                     "error_str": "could not start a worker process"}
         self._lease_counter += 1
         lease_id = f"{self.node_id[:12]}-{self._lease_counter}"
-        lease = _Lease(lease_id, worker, demand)
+        lease = _Lease(lease_id, worker, demand, bundle_key)
         worker.lease_id = lease_id
         self._leases[lease_id] = lease
         return {"granted": {
@@ -497,6 +575,16 @@ class NodeAgent(RpcHost):
             return w
         return None
 
+    def _lease_sched(self, lease: _Lease) -> LocalScheduler:
+        if lease.bundle_key:
+            sched = self._bundles.get(lease.bundle_key)
+            if sched is not None:
+                return sched
+            # bundle already returned: its resources went back to the
+            # node pool wholesale; nothing further to release
+            return LocalScheduler(NodeResources(lease.resources))
+        return self.local
+
     async def rpc_return_lease(self, lease_id: str, kill_worker: bool = False):
         lease = self._leases.pop(lease_id, None)
         if lease is None:
@@ -510,7 +598,7 @@ class NodeAgent(RpcHost):
                 pass
         else:
             self._idle.append(w)
-        for tok in self.local.release(lease.resources):
+        for tok in self._lease_sched(lease).release(lease.resources):
             self._grant_token(tok)
         return {"ok": True}
 

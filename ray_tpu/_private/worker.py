@@ -164,6 +164,7 @@ class CoreWorker(RpcHost):
         self._locations: Dict[str, Tuple[str, int]] = {}  # owned oid -> node
         self._containers: Dict[str, List[ObjectRef]] = {}  # outer -> inner pins
         self._sched: Dict[tuple, _SchedState] = {}
+        self._pg_cache: Dict[str, Any] = {}
         self._actors: Dict[str, _ActorState] = {}
         self._agent_clients: Dict[Tuple[str, int], RpcClient] = {}
         self._worker_clients: Dict[Tuple[str, int], RpcClient] = {}
@@ -524,14 +525,18 @@ class CoreWorker(RpcHost):
 
     def submit_task(self, function_id: str, args: tuple, kwargs: dict,
                     num_returns: int = 1, resources: Optional[Dict[str, float]] = None,
-                    max_retries: int = 3, name: str = "") -> List[ObjectRef]:
+                    max_retries: int = 3, name: str = "",
+                    placement_group_id: str = "",
+                    bundle_index: int = -1) -> List[ObjectRef]:
         tid = TaskID.for_normal_task(JobID.from_hex(self.job_id))
         wire_args, contained = self._serialize_args(args, kwargs)
         spec = TaskSpec(
             task_id=tid.hex(), job_id=self.job_id, kind=NORMAL_TASK,
             function_id=function_id, args=wire_args, num_returns=num_returns,
             resources=resources or {"CPU": 1}, max_retries=max_retries,
-            name=name, owner_addr=self.address, caller_id=self.worker_id)
+            name=name, owner_addr=self.address, caller_id=self.worker_id,
+            placement_group_id=placement_group_id,
+            bundle_index=max(bundle_index, 0) if placement_group_id else -1)
         task = _TaskState(spec, contained)
         refs = []
         for oid in task.return_oids:
@@ -599,8 +604,26 @@ class CoreWorker(RpcHost):
             state.inflight_requests += 1
             self._spawn(self._request_lease(state, state.pending[0].spec))
 
+    async def _pg_bundle_addr(self, pg_id: str, bundle_index: int,
+                              refresh: bool = False) -> Optional[Tuple[str, int]]:
+        """Resolve (and cache) the agent address hosting a PG bundle."""
+        info = None if refresh else self._pg_cache.get(pg_id)
+        if info is None or info.get("state") != "CREATED":
+            info = await self.head.aio.call(
+                "get_placement_group", pg_id=pg_id, wait=True,
+                timeout=config.pubsub_poll_timeout_ms / 1000.0 + 10.0)
+            self._pg_cache[pg_id] = info
+        placements = info.get("placements") or []
+        if info.get("state") != "CREATED" or bundle_index >= len(placements):
+            return None
+        p = placements[bundle_index]
+        return (p["addr"][0], p["addr"][1]) if p else None
+
     async def _request_lease(self, state: _SchedState, spec: TaskSpec):
         try:
+            if spec.placement_group_id:
+                await self._request_pg_lease(state, spec)
+                return
             agent_addr = self.agent_addr
             for _hop in range(8):
                 try:
@@ -633,6 +656,43 @@ class CoreWorker(RpcHost):
         finally:
             state.inflight_requests -= 1
             self._pump(state)
+
+    async def _request_pg_lease(self, state: _SchedState, spec: TaskSpec):
+        """Leases for bundle-targeted tasks go straight to the node that
+        reserved the bundle (no hybrid policy / spillback)."""
+        idx = max(spec.bundle_index, 0)
+        for attempt in range(4):
+            addr = await self._pg_bundle_addr(spec.placement_group_id, idx,
+                                             refresh=attempt > 0)
+            if addr is None:
+                err = SchedulingError(
+                    f"placement group {spec.placement_group_id[:12]} bundle "
+                    f"{idx} is not available")
+                while state.pending:
+                    self._fail_task(state.pending.popleft(), err)
+                return
+            try:
+                c = await self._aclient_agent(addr)
+                reply = await c.call(
+                    "request_lease", spec=spec.to_wire(),
+                    timeout=config.worker_lease_timeout_ms / 1000.0 + 10.0)
+            except (ConnectionLost, RpcError):
+                continue  # bundle node died: refresh placement and retry
+            if "granted" in reply:
+                g = reply["granted"]
+                lease = _Lease(g["lease_id"], g["worker_id"],
+                               (g["addr"][0], g["addr"][1]), addr)
+                state.leases.append(lease)
+                return
+            if reply.get("error") == "bundle not reserved":
+                continue  # rescheduled elsewhere: refresh and retry
+            if reply.get("error") == "infeasible":
+                err = SchedulingError(reply.get("error_str", "infeasible"))
+                while state.pending:
+                    self._fail_task(state.pending.popleft(), err)
+                return
+            if not state.pending:
+                return
 
     def _assign(self, state: _SchedState, lease: _Lease, task: _TaskState):
         lease.busy = task
@@ -744,7 +804,9 @@ class CoreWorker(RpcHost):
     def create_actor(self, class_id: str, args: tuple, kwargs: dict,
                      resources: Optional[Dict[str, float]] = None,
                      max_restarts: int = 0, max_task_retries: int = 0,
-                     max_concurrency: int = 1, name: str = "") -> str:
+                     max_concurrency: int = 1, name: str = "",
+                     placement_group_id: str = "",
+                     bundle_index: int = -1) -> str:
         aid = ActorID.of(JobID.from_hex(self.job_id))
         tid = TaskID.for_actor_creation(aid)
         wire_args, contained = self._serialize_args(args, kwargs)
@@ -754,7 +816,9 @@ class CoreWorker(RpcHost):
             resources=resources or {"CPU": 1}, actor_id=aid.hex(),
             max_restarts=max_restarts, max_concurrency=max_concurrency,
             max_retries=max_task_retries, name=name,
-            owner_addr=self.address, caller_id=self.worker_id)
+            owner_addr=self.address, caller_id=self.worker_id,
+            placement_group_id=placement_group_id,
+            bundle_index=max(bundle_index, 0) if placement_group_id else -1)
         self.head.call("create_actor", spec=spec.to_wire(), name=name)
         # hold arg refs until the actor is alive; the head owns creation
         astate = _ActorState(aid.hex())

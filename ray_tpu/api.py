@@ -171,7 +171,8 @@ class RemoteFunction:
     (reference: python/ray/remote_function.py)."""
 
     _OPT_KEYS = ("num_returns", "num_cpus", "num_gpus", "num_tpus",
-                 "resources", "max_retries", "name")
+                 "resources", "max_retries", "name",
+                 "placement_group", "placement_group_bundle_index")
 
     def __init__(self, fn, **opts):
         bad = set(opts) - set(self._OPT_KEYS)
@@ -204,10 +205,13 @@ class RemoteFunction:
 
     def remote(self, *args, **kwargs):
         w = _worker()
+        pg = self._opts.get("placement_group")
         refs = w.submit_task(
             self._fid(w), args, kwargs, num_returns=self._num_returns,
             resources=self._resources, max_retries=self._max_retries,
-            name=self._name)
+            name=self._name,
+            placement_group_id=pg.id if pg is not None else "",
+            bundle_index=self._opts.get("placement_group_bundle_index", -1))
         if self._num_returns == 1:
             return refs[0]
         return refs
@@ -307,7 +311,8 @@ class ActorHandle:
 class ActorClass:
     _OPT_KEYS = ("num_cpus", "num_gpus", "num_tpus", "resources",
                  "max_restarts", "max_task_retries", "max_concurrency",
-                 "name", "lifetime")
+                 "name", "lifetime",
+                 "placement_group", "placement_group_bundle_index")
 
     def __init__(self, cls, **opts):
         bad = set(opts) - set(self._OPT_KEYS)
@@ -339,11 +344,14 @@ class ActorClass:
         if cid is None:
             cid = w.functions.export(self._cls)
             self._class_ids = {w.worker_id: cid}
+        pg = self._opts.get("placement_group")
         actor_id = w.create_actor(
             cid, args, kwargs, resources=self._resources,
             max_restarts=self._max_restarts,
             max_task_retries=self._max_task_retries,
-            max_concurrency=self._max_concurrency, name=self._name)
+            max_concurrency=self._max_concurrency, name=self._name,
+            placement_group_id=pg.id if pg is not None else "",
+            bundle_index=self._opts.get("placement_group_bundle_index", -1))
         owner = self._lifetime != "detached"
         return ActorHandle(actor_id, max_task_retries=self._max_task_retries,
                            _owner=owner)

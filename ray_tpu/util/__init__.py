@@ -1,0 +1,6 @@
+"""ray_tpu.util: placement groups, collectives, and cluster utilities."""
+
+from ray_tpu.util.placement_group import (PlacementGroup, placement_group,
+                                          remove_placement_group)
+
+__all__ = ["PlacementGroup", "placement_group", "remove_placement_group"]

@@ -1,0 +1,107 @@
+"""Placement group + gang scheduling tests.
+
+Mirrors the reference's PG behavior
+(reference: python/ray/tests/test_placement_group.py; bundle policies
+src/ray/raylet/scheduling/policy/bundle_scheduling_policy.h), including
+the TPU-slice gang pattern: per-host bundles reserved all-or-nothing.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import placement_group, remove_placement_group
+from ray_tpu.util.placement_group import PlacementGroupError, tpu_slice_bundles
+
+
+@pytest.fixture
+def tpu_cluster():
+    """3 nodes: 2 'TPU hosts' with 4 fake chips each + 1 CPU-only."""
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2, resources={"TPU": 4})
+    cluster.add_node(num_cpus=2, resources={"TPU": 4})
+    ray_tpu.init(address=cluster.address)
+    try:
+        yield cluster
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_pack_pg_basic(tpu_cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK").ready(timeout=30)
+
+    @ray_tpu.remote(placement_group=pg, placement_group_bundle_index=0)
+    def a():
+        return os.getpid()
+
+    @ray_tpu.remote(placement_group=pg, placement_group_bundle_index=1)
+    def b():
+        return os.getpid()
+
+    pa, pb = ray_tpu.get([a.remote(), b.remote()], timeout=60)
+    assert pa and pb
+    remove_placement_group(pg)
+
+
+def test_strict_spread_lands_on_distinct_nodes(tpu_cluster):
+    pg = placement_group([{"TPU": 4}, {"TPU": 4}],
+                         strategy="STRICT_SPREAD").ready(timeout=30)
+    info = pg._info()
+    nodes = [p["node_id"] for p in info["placements"]]
+    assert len(set(nodes)) == 2
+    remove_placement_group(pg)
+
+
+def test_gang_atomicity_infeasible(tpu_cluster):
+    """3 TPU-hosts-worth of bundles on a 2-host cluster: nothing may be
+    left partially reserved (slice all-or-nothing)."""
+    pg = placement_group([{"TPU": 4}, {"TPU": 4}, {"TPU": 4}],
+                         strategy="STRICT_SPREAD")
+    assert not pg.wait(timeout=3)
+    # all TPU resources must still be available to others
+    pg2 = placement_group(tpu_slice_bundles(num_hosts=2, chips_per_host=4),
+                          strategy="STRICT_SPREAD")
+    assert pg2.wait(timeout=30)
+    remove_placement_group(pg2)
+    remove_placement_group(pg)
+
+
+def test_pg_task_uses_bundle_resources(tpu_cluster):
+    pg = placement_group([{"TPU": 4, "CPU": 1}]).ready(timeout=30)
+
+    @ray_tpu.remote(num_tpus=4, placement_group=pg)
+    def with_chips():
+        return "got chips"
+
+    assert ray_tpu.get(with_chips.remote(), timeout=60) == "got chips"
+    remove_placement_group(pg)
+
+
+def test_actor_in_pg(tpu_cluster):
+    pg = placement_group([{"TPU": 4, "CPU": 1}]).ready(timeout=30)
+
+    @ray_tpu.remote(num_tpus=2, placement_group=pg)
+    class Shard:
+        def where(self):
+            return os.getpid()
+
+    a, b = Shard.remote(), Shard.remote()  # both fit the 4-chip bundle
+    pids = ray_tpu.get([a.where.remote(), b.where.remote()], timeout=60)
+    assert len(pids) == 2
+    remove_placement_group(pg)
+
+
+def test_remove_pg_frees_resources(tpu_cluster):
+    pg = placement_group([{"TPU": 4}, {"TPU": 4}],
+                         strategy="STRICT_SPREAD").ready(timeout=30)
+    remove_placement_group(pg)
+    time.sleep(0.2)
+    # resources back: a fresh identical PG must succeed
+    pg2 = placement_group([{"TPU": 4}, {"TPU": 4}],
+                          strategy="STRICT_SPREAD")
+    assert pg2.wait(timeout=30)
+    remove_placement_group(pg2)
