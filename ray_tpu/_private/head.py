@@ -610,7 +610,12 @@ class HeadService(RpcHost):
             if plan is not None:
                 ok = await self._reserve_pg(entry, plan)
                 if ok:
-                    if entry.state != PG_PENDING:  # removed while reserving
+                    removed = entry.state != PG_PENDING
+                    # a plan node may have died between the last reserve
+                    # RPC and now — committing CREATED then would strand
+                    # the group (the death event is already consumed)
+                    lost_node = any(nid not in self.nodes for nid in plan)
+                    if removed or lost_node:
                         for idx, nid in enumerate(plan):
                             node = self.nodes.get(nid)
                             if node is not None:
@@ -620,7 +625,10 @@ class HeadService(RpcHost):
                                         bundle_index=idx)
                                 except Exception:
                                     pass
-                        return
+                        if removed:
+                            return
+                        entry.placements = [None] * len(entry.bundles)
+                        continue  # replan from scratch
                     entry.placements = plan
                     entry.state = PG_CREATED
                     entry.wake()

@@ -400,9 +400,7 @@ class NodeAgent(RpcHost):
         if sched is None:
             return {"ok": False}
         # wake queued lease requests; they re-check and see the bundle gone
-        queued = [token for token, _ in sched._queue]
-        sched._queue.clear()
-        for token in queued:
+        for token in sched.cancel_all():
             self._grant_token(token)
         # kill leases still running against the bundle (reference: PG
         # removal kills its tasks/actors)

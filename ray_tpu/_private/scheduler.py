@@ -49,6 +49,13 @@ class LocalScheduler:
                 return True, self.drain()
         return False, []
 
+    def cancel_all(self) -> List[object]:
+        """Drop every queued request; returns their tokens (the caller
+        wakes the waiters, who observe the queue's backing pool is gone)."""
+        tokens = [t for t, _ in self._queue]
+        self._queue.clear()
+        return tokens
+
     def release(self, demand: ResourceSet) -> List[object]:
         """Release resources; returns tokens of newly grantable requests."""
         self.resources.release(demand)

@@ -605,8 +605,11 @@ class CoreWorker(RpcHost):
             self._spawn(self._request_lease(state, state.pending[0].spec))
 
     async def _pg_bundle_addr(self, pg_id: str, bundle_index: int,
-                              refresh: bool = False) -> Optional[Tuple[str, int]]:
-        """Resolve (and cache) the agent address hosting a PG bundle."""
+                              refresh: bool = False):
+        """Resolve (and cache) the agent address hosting a PG bundle.
+
+        Returns (status, addr): status in {"ok", "pending", "gone"}.
+        """
         info = None if refresh else self._pg_cache.get(pg_id)
         if info is None or info.get("state") != "CREATED":
             info = await self.head.aio.call(
@@ -614,10 +617,14 @@ class CoreWorker(RpcHost):
                 timeout=config.pubsub_poll_timeout_ms / 1000.0 + 10.0)
             self._pg_cache[pg_id] = info
         placements = info.get("placements") or []
+        if info.get("state") == "PENDING":
+            return "pending", None
         if info.get("state") != "CREATED" or bundle_index >= len(placements):
-            return None
+            return "gone", None
         p = placements[bundle_index]
-        return (p["addr"][0], p["addr"][1]) if p else None
+        if p is None:
+            return "pending", None  # bundle being re-reserved after node death
+        return "ok", (p["addr"][0], p["addr"][1])
 
     async def _request_lease(self, state: _SchedState, spec: TaskSpec):
         try:
@@ -661,16 +668,23 @@ class CoreWorker(RpcHost):
         """Leases for bundle-targeted tasks go straight to the node that
         reserved the bundle (no hybrid policy / spillback)."""
         idx = max(spec.bundle_index, 0)
-        for attempt in range(4):
-            addr = await self._pg_bundle_addr(spec.placement_group_id, idx,
-                                             refresh=attempt > 0)
-            if addr is None:
+        attempt = 0
+        while True:
+            status, addr = await self._pg_bundle_addr(
+                spec.placement_group_id, idx, refresh=attempt > 0)
+            if status == "pending":
+                # the group (or this bundle) isn't placed yet: the head
+                # keeps scheduling it; waiting must not consume attempts
+                attempt = max(attempt, 1)
+                continue
+            if status == "gone" or attempt >= 4:
                 err = SchedulingError(
                     f"placement group {spec.placement_group_id[:12]} bundle "
                     f"{idx} is not available")
                 while state.pending:
                     self._fail_task(state.pending.popleft(), err)
                 return
+            attempt += 1
             try:
                 c = await self._aclient_agent(addr)
                 reply = await c.call(

@@ -1,0 +1,10 @@
+"""TPU compute kernels: ring attention, flash attention.
+
+The reference has no sequence/context parallelism anywhere (SURVEY §5.7
+— verified absent), so this package is green-field: long-context support
+is built as a first-class mesh axis ("sp") with KV rotation over ICI.
+"""
+
+from ray_tpu.ops.ring_attention import make_ring_attention, ring_attention
+
+__all__ = ["ring_attention", "make_ring_attention"]

@@ -1,0 +1,122 @@
+"""Ring attention: sequence-parallel causal attention over an "sp" mesh axis.
+
+Each device holds one sequence block of Q, K, V. The KV block rotates
+around the ring with `lax.ppermute` (ICI neighbour exchange on TPU) while
+the local Q block accumulates attention with an online (flash-style)
+softmax — max/denominator carried across blocks — so the full sequence
+never materializes on any chip. Memory per chip is O(S/n_sp), enabling
+context lengths that a single chip cannot hold.
+
+Green-field design (the reference has no SP/CP — SURVEY §5.7); the
+algorithm follows the public ring-attention recipe: blockwise attention +
+KV rotation, compute overlapping the permute. XLA overlaps the ppermute
+with the block matmuls; a Pallas double-buffered variant can tighten this
+further on real ICI.
+
+GQA layout matches the model: q [B, S, H, D], k/v [B, S, Hkv, D].
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, mask, m, l, acc):
+    """One flash-attention accumulation step over a KV block.
+
+    q: [B,S,H,D]; k/v: [B,T,Hkv,D]; mask: [S,T] (True = attend);
+    m/l: [B,H,G,S]; acc: [B,S,H,D] in fp32.
+    """
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    g = h // hkv
+    qg = q.reshape(b, s, hkv, g, d)
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    logits = jnp.einsum("bshgd,bthd->bhgst", qg, k).astype(jnp.float32) * scale
+    logits = jnp.where(mask[None, None, None], logits, _NEG_INF)
+    m_blk = jnp.max(logits, axis=-1)  # [B,Hkv,G,S]
+    m_new = jnp.maximum(m, m_blk)
+    # keep fully-masked rows stable: exp(-inf - (-inf)) guards
+    p = jnp.exp(logits - m_new[..., None])
+    p = jnp.where(mask[None, None, None], p, 0.0)
+    correction = jnp.exp(m - m_new)
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhgst,bthd->bshgd", p.astype(v.dtype), v).astype(jnp.float32)
+    acc_new = acc * correction.transpose(0, 3, 1, 2)[..., None].reshape(
+        b, s, hkv * g, 1) + pv.reshape(b, s, h, d)
+    return m_new, l_new, acc_new
+
+
+def _ring_body(q, k0, v0, axis_name: str, n_blocks: int, block_len: int,
+               causal: bool):
+    """Runs on each device inside shard_map; returns the local output."""
+    b, s, h, d = q.shape
+    hkv = k0.shape[2]
+    g = h // hkv
+    my_idx = jax.lax.axis_index(axis_name)
+    q_pos = my_idx * block_len + jnp.arange(block_len)
+
+    m0 = jnp.full((b, hkv, g, s), _NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((b, hkv, g, s), dtype=jnp.float32)
+    acc0 = jnp.zeros((b, s, h, d), dtype=jnp.float32)
+
+    def step(t, carry):
+        k, v, m, l, acc = carry
+        kv_idx = (my_idx - t) % n_blocks
+        k_pos = kv_idx * block_len + jnp.arange(block_len)
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = jnp.ones((block_len, block_len), dtype=bool)
+        m, l, acc = _block_attend(q, k, v, mask, m, l, acc)
+        # rotate KV to the next ring position (ICI neighbour exchange)
+        n = n_blocks
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        return k, v, m, l, acc
+
+    _, _, m, l, acc = jax.lax.fori_loop(
+        0, n_blocks, step, (k0, v0, m0, l0, acc0))
+    l = jnp.maximum(l, 1e-20)  # fully-masked rows (none under causal) stay finite
+    denom = l.transpose(0, 3, 1, 2).reshape(b, s, h, 1)
+    return (acc / denom).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis_name: str = "sp", causal: bool = True,
+                   batch_axes=("dp", "fsdp"), head_axis: Optional[str] = "tp"):
+    """Sequence-parallel attention. q/k/v are GLOBAL arrays (under jit with
+    GSPMD shardings); the shard_map distributes over the mesh:
+    batch over (dp, fsdp), sequence over sp, heads over tp."""
+    n_sp = mesh.shape[axis_name]
+    if n_sp == 1:
+        from ray_tpu.models.llama import default_attention
+
+        return default_attention(q, k, v, causal=causal)
+    seq_len = q.shape[1]
+    if seq_len % n_sp:
+        raise ValueError(f"sequence {seq_len} not divisible by sp={n_sp}")
+    block_len = seq_len // n_sp
+    spec = P(batch_axes, axis_name, head_axis, None)
+    body = partial(_ring_body, axis_name=axis_name, n_blocks=n_sp,
+                   block_len=block_len, causal=causal)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+def make_ring_attention(mesh, axis_name: str = "sp", **kw) -> Callable:
+    """Attention-kernel hook for LlamaModel(kernel=...)."""
+
+    def kernel(q, k, v, causal: bool = True):
+        return ring_attention(q, k, v, mesh, axis_name=axis_name,
+                              causal=causal, **kw)
+
+    return kernel

@@ -30,6 +30,11 @@ def build_llama_train_state(cfg, mesh, rng_seed: int = 0,
                                       llama_param_rules)
     from ray_tpu.parallel.mesh import shard_batch, shard_params
 
+    if attention_kernel is None and mesh.shape.get("sp", 1) > 1:
+        # sequence-parallel mesh: ring attention rotates KV over ICI
+        from ray_tpu.ops.ring_attention import make_ring_attention
+
+        attention_kernel = make_ring_attention(mesh)
     model = LlamaModel(cfg, kernel=attention_kernel)
     rng = jax.random.PRNGKey(rng_seed)
     sample = jnp.zeros((batch_size, seq_len), dtype=jnp.int32)
