@@ -314,6 +314,26 @@ class CoreWorker(RpcHost):
     async def rpc_ping(self):
         return {"pong": True, "mode": self.mode}
 
+    # ---- host-collective plane (ray_tpu.util.collective) ----
+
+    async def rpc_coll_push(self, group: str, seq: int, src: int,
+                            payload: bytes, chan: str = "op"):
+        from ray_tpu.util import collective
+
+        collective._deliver_push(group, chan, seq, src, payload)
+
+    async def _acoll_send(self, addr, group: str, chan: str, seq: int,
+                          src: int, payload: bytes):
+        try:
+            c = await self._aclient_worker(tuple(addr))
+            await c.oneway("coll_push", group=group, chan=chan, seq=seq,
+                           src=src, payload=payload)
+        except Exception as e:
+            import sys
+
+            print(f"[ray_tpu.collective] send {group}/{chan}#{seq} "
+                  f"rank {src} -> {addr} failed: {e}", file=sys.stderr)
+
     # ------------------------------------------------------------------- put
 
     def _next_put_oid(self) -> str:

@@ -9,11 +9,11 @@ of wrapping modules in DDP/FSDP.
 """
 
 from ray_tpu.train.session import (TrainContext, get_context, report,
-                                   get_checkpoint)
+                                   get_checkpoint, get_dataset_shard)
 from ray_tpu.train.trainer import (JaxTrainer, Result, RunConfig,
                                    ScalingConfig, TrainingFailedError)
 from ray_tpu.train.worker_group import WorkerGroup
 
 __all__ = ["JaxTrainer", "ScalingConfig", "RunConfig", "Result",
            "TrainingFailedError", "WorkerGroup", "TrainContext",
-           "get_context", "report", "get_checkpoint"]
+           "get_context", "report", "get_checkpoint", "get_dataset_shard"]

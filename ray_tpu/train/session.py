@@ -36,11 +36,13 @@ class TrainContext:
 
 class _Session:
     def __init__(self, ctx: TrainContext,
-                 checkpoint_to_restore: Optional[str] = None):
+                 checkpoint_to_restore: Optional[str] = None,
+                 datasets: Optional[Dict[str, Any]] = None):
         self.ctx = ctx
         self.lock = threading.Lock()
         self.reports: List[Dict[str, Any]] = []
         self.checkpoint_to_restore = checkpoint_to_restore
+        self.datasets = datasets or {}
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
         self.final: Any = None
@@ -85,3 +87,12 @@ def report(metrics: Dict[str, Any], checkpoint: Optional[str] = None) -> None:
 def get_checkpoint() -> Optional[str]:
     """Checkpoint directory to restore from, when resuming."""
     return _get_session().checkpoint_to_restore
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's shard of a dataset passed to JaxTrainer(datasets=...)
+    (reference: ray.train.get_dataset_shard)."""
+    ds = _get_session().datasets.get(name)
+    if ds is None:
+        raise KeyError(f"no dataset shard named {name!r} for this worker")
+    return ds

@@ -98,14 +98,21 @@ class JaxTrainer:
             coordinator = f"{info0['ip']}:{port}"
             self._init_distributed(group, coordinator, n)
         fn_blob = cloudpickle.dumps(self.train_loop)
-        cfg = self.config
-        if self.datasets:
-            cfg = dict(cfg or {})
-            cfg["_datasets"] = self.datasets
-        group.execute("run_async", fn_blob, cfg,
-                      checkpoint=self.resume_from_checkpoint,
-                      experiment_name=self.run_config.name,
-                      trial_dir=trial_dir)
+        # dataset ingest: each worker gets its round-robin block shard
+        # (reference: _internal/data_config.py streaming_split)
+        shard_map: Dict[int, Dict[str, Any]] = {r: {} for r in range(n)}
+        for name, ds in self.datasets.items():
+            for rank, shard in enumerate(ds.split(n)):
+                shard_map[rank][name] = shard
+        import ray_tpu
+
+        refs = []
+        for rank, w in enumerate(group.workers):
+            refs.append(w.run_async.remote(
+                fn_blob, self.config, checkpoint=self.resume_from_checkpoint,
+                experiment_name=self.run_config.name, trial_dir=trial_dir,
+                datasets=shard_map[rank] or None))
+        ray_tpu.get(refs, timeout=120.0)
         return self._poll_until_done(group, trial_dir)
 
     def _init_distributed(self, group: WorkerGroup, coordinator: str, n: int):

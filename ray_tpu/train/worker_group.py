@@ -61,14 +61,16 @@ class TrainWorker:
 
     def run_async(self, fn_blob: bytes, config: Optional[Dict[str, Any]],
                   checkpoint: Optional[str] = None,
-                  experiment_name: str = "", trial_dir: str = "") -> bool:
+                  experiment_name: str = "", trial_dir: str = "",
+                  datasets: Optional[Dict[str, Any]] = None) -> bool:
         from ray_tpu.train.session import TrainContext, _Session, _set_session
 
         fn = cloudpickle.loads(fn_blob)
         ctx = TrainContext(rank=self.rank, world_size=self.world_size,
                            local_rank=0, experiment_name=experiment_name,
                            trial_dir=trial_dir)
-        session = _Session(ctx, checkpoint_to_restore=checkpoint)
+        session = _Session(ctx, checkpoint_to_restore=checkpoint,
+                           datasets=datasets)
         self._session = session
 
         def target():

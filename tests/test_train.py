@@ -162,6 +162,28 @@ def test_worker_group_execute(cluster):
     g.shutdown()
 
 
+def test_trainer_dataset_ingest(cluster):
+    """Datasets flow to workers as block shards (reference:
+    streaming_split ingest; object-plane boundary SURVEY §3.4 step 6)."""
+    from ray_tpu import data as rtd
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+
+    def loop(config):
+        from ray_tpu import train as rt_train
+
+        shard = rt_train.get_dataset_shard("train")
+        seen = 0
+        for batch in shard.iter_batches(batch_size=10):
+            seen += len(batch["id"])
+            rt_train.report({"seen": seen})
+        return seen
+
+    ds = rtd.range(40, num_blocks=4)
+    result = JaxTrainer(loop, scaling_config=ScalingConfig(num_workers=2),
+                        train_loop_config={}, datasets={"train": ds}).fit()
+    assert sum(result.per_worker_final) == 40
+
+
 def test_report_outside_session_raises():
     from ray_tpu.train import report
 
