@@ -1022,11 +1022,24 @@ class CoreWorker(RpcHost):
         while True:
             item = self._task_queue.get()
             if item is None:
+                # propagate shutdown to any extra concurrency threads
+                for _ in self._exec_threads:
+                    self._task_queue.put(None)
                 break
             spec_wire, fut = item
             reply = self._execute(spec_wire)
             self._loop().call_soon_threadsafe(
                 lambda f=fut, r=reply: (not f.done()) and f.set_result(r))
+
+    def _start_concurrency_threads(self, n: int):
+        """Extra executors for actors with max_concurrency > 1
+        (reference: concurrency groups / threaded actors,
+        transport/concurrency_group_manager.h)."""
+        for i in range(n):
+            t = threading.Thread(target=self.exec_loop,
+                                 name=f"rt-exec-{i + 1}", daemon=True)
+            t.start()
+            self._exec_threads.append(t)
 
     def _execute(self, spec_wire: Dict[str, Any]) -> Dict[str, Any]:
         spec = TaskSpec.from_wire(spec_wire)
@@ -1041,6 +1054,8 @@ class CoreWorker(RpcHost):
                 cls = self.functions.fetch(spec.function_id)
                 self._actor_instance = cls(*args, **kwargs)
                 self._actor_creation_spec = spec
+                if spec.max_concurrency > 1 and not self._exec_threads:
+                    self._start_concurrency_threads(spec.max_concurrency - 1)
                 return {"results": []}
             if spec.kind == ACTOR_TASK:
                 if self._actor_instance is None:

@@ -1,0 +1,15 @@
+"""ray_tpu.serve: model serving on actor replicas.
+
+Equivalent of Ray Serve (reference: python/ray/serve/ — api.py
+@serve.deployment :248 / serve.run :543, controller _private/controller.py,
+router _private/router.py + pow-2 replica scheduler, batching
+batching.py).  TPU slant: @serve.batch coalesces concurrent requests
+into one jitted forward, the TPU-efficient serving shape.
+"""
+
+from ray_tpu.serve.api import (Application, Deployment, DeploymentHandle,
+                               batch, delete, deployment, get_handle, run,
+                               shutdown)
+
+__all__ = ["deployment", "run", "get_handle", "delete", "shutdown",
+           "batch", "Deployment", "DeploymentHandle", "Application"]

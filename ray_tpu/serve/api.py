@@ -1,0 +1,365 @@
+"""Serve API: deployments, controller, handles, batching.
+
+Reference mapping:
+  - @deployment / .options / .bind  -> python/ray/serve/api.py:248
+  - serve.run / delete / get_handle -> api.py:543, _private/api.py
+  - ServeController (named actor)   -> _private/controller.py
+  - DeploymentHandle + router       -> handle.py, _private/router.py
+    (least-outstanding-requests among replicas = the pow-2 intent with
+    exact local counts)
+  - @serve.batch                    -> batching.py (replica-side dynamic
+    batching; replicas run with max_concurrency > 1 so concurrent calls
+    coalesce into one forward — the TPU-efficient shape)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+CONTROLLER_NAME = "_serve_controller"
+
+_batch_init_lock = threading.Lock()
+
+
+# ----------------------------------------------------------------- batching
+
+
+def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Dynamic request batching for replica methods.
+
+    Concurrent callers (replica threads) enqueue items; one caller
+    becomes the flusher, invokes the wrapped function ONCE with the list
+    of items, and distributes the per-item results.
+    """
+
+    def wrap(fn: Callable) -> Callable:
+        # state is created lazily per process/instance: a _BatchState
+        # holds locks, which would make the decorated class unpicklable
+        cfg = (max_batch_size, batch_wait_timeout_s)
+        state_key = f"_serve_batch_state_{getattr(fn, '__name__', 'fn')}"
+
+        def _state_for(owner) -> "_BatchState":
+            with _batch_init_lock:
+                holder = owner if owner is not None else wrapped
+                state = getattr(holder, state_key, None)
+                if state is None:
+                    state = _BatchState(*cfg)
+                    setattr(holder, state_key, state)
+                return state
+
+        def wrapped(self_or_item, *maybe_item):
+            # support methods (self, item) and free functions (item)
+            if maybe_item:
+                owner, item = self_or_item, maybe_item[0]
+                call = lambda items: fn(owner, items)
+            else:
+                owner, item = None, self_or_item
+                call = fn
+            return _state_for(owner).submit(item, call)
+
+        wrapped.__name__ = getattr(fn, "__name__", "batched")
+        wrapped._is_serve_batch = True
+        return wrapped
+
+    if _func is not None:
+        return wrap(_func)
+    return wrap
+
+
+class _BatchState:
+    def __init__(self, max_batch_size: int, wait_s: float):
+        self.max = max_batch_size
+        self.wait = wait_s
+        self.lock = threading.Lock()
+        self.items: List[Any] = []
+        self.futures: List[Any] = []
+        self.flusher_here = False
+
+    def submit(self, item: Any, call: Callable[[List[Any]], List[Any]]):
+        import concurrent.futures as cf
+
+        fut: cf.Future = cf.Future()
+        with self.lock:
+            self.items.append(item)
+            self.futures.append(fut)
+            i_flush = not self.flusher_here
+            if i_flush:
+                self.flusher_here = True
+        if not i_flush:
+            return fut.result(timeout=120)
+        # this caller is the flusher: drain every batch, then hand back
+        try:
+            while True:
+                deadline = time.monotonic() + self.wait
+                while time.monotonic() < deadline:
+                    with self.lock:
+                        if len(self.items) >= self.max:
+                            break
+                    time.sleep(min(0.001, self.wait / 4 or 0.001))
+                with self.lock:
+                    items = self.items[:self.max]
+                    futures = self.futures[:self.max]
+                    del self.items[:self.max]
+                    del self.futures[:self.max]
+                self._run_batch(call, items, futures)
+                with self.lock:
+                    if not self.items:
+                        self.flusher_here = False
+                        break
+        except BaseException:
+            with self.lock:
+                self.flusher_here = False
+            raise
+        return fut.result(timeout=120)
+
+    @staticmethod
+    def _run_batch(call, items, futures):
+        try:
+            results = call(items)
+            if len(results) != len(items):
+                raise ValueError(
+                    f"@serve.batch function returned {len(results)} results "
+                    f"for {len(items)} inputs")
+            for f, r in zip(futures, results):
+                f.set_result(r)
+        except BaseException as e:
+            for f in futures:
+                if not f.done():
+                    f.set_exception(e)
+
+
+# -------------------------------------------------------------- deployment
+
+
+@dataclass
+class Deployment:
+    func_or_class: Any
+    name: str
+    num_replicas: int = 1
+    max_ongoing_requests: int = 8
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    init_args: tuple = ()
+    init_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+    def options(self, **opts) -> "Deployment":
+        d = Deployment(self.func_or_class, self.name, self.num_replicas,
+                       self.max_ongoing_requests,
+                       dict(self.ray_actor_options),
+                       self.init_args, dict(self.init_kwargs))
+        for k, v in opts.items():
+            setattr(d, k, v)
+        return d
+
+    def bind(self, *args, **kwargs) -> "Application":
+        d = self.options()
+        d.init_args = args
+        d.init_kwargs = kwargs
+        return Application(d)
+
+
+@dataclass
+class Application:
+    deployment: Deployment
+
+
+def deployment(_cls: Any = None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_ongoing_requests: int = 8,
+               ray_actor_options: Optional[Dict[str, Any]] = None):
+    def make(target):
+        return Deployment(target, name or getattr(target, "__name__", "app"),
+                          num_replicas, max_ongoing_requests,
+                          ray_actor_options or {})
+
+    if _cls is not None:
+        return make(_cls)
+    return make
+
+
+class _Replica:
+    """Actor wrapping the user callable (reference: _private/replica.py)."""
+
+    def __init__(self, target_blob: bytes, init_args, init_kwargs):
+        import cloudpickle
+
+        target = cloudpickle.loads(target_blob)
+        if isinstance(target, type):
+            self._callable = target(*init_args, **init_kwargs)
+        else:
+            self._callable = target
+
+    def handle_request(self, method: str, args, kwargs):
+        if method == "__call__":
+            return self._callable(*args, **kwargs)
+        return getattr(self._callable, method)(*args, **kwargs)
+
+    def health(self):
+        return True
+
+
+class ServeController:
+    """Named actor owning deployment state
+    (reference: _private/controller.py reconciliation)."""
+
+    def __init__(self):
+        self.apps: Dict[str, Dict[str, Any]] = {}
+
+    def deploy(self, name: str, target_blob: bytes, num_replicas: int,
+               max_ongoing: int, init_args, init_kwargs,
+               actor_options: Dict[str, Any]):
+        import ray_tpu
+
+        existing = self.apps.get(name)
+        if existing:
+            for h in existing["replicas"]:
+                try:
+                    ray_tpu.kill(h)
+                except Exception:
+                    pass
+        cls = ray_tpu.remote(_Replica).options(
+            max_concurrency=max(2, max_ongoing), **actor_options)
+        replicas = [cls.remote(target_blob, init_args, init_kwargs)
+                    for _ in range(num_replicas)]
+        # block until every replica's constructor finished (model loaded)
+        ray_tpu.get([r.health.remote() for r in replicas], timeout=600)
+        self.apps[name] = {"replicas": replicas,
+                           "max_ongoing": max_ongoing}
+        return True
+
+    def get_replicas(self, name: str):
+        app = self.apps.get(name)
+        if app is None:
+            return None
+        return [r._actor_id for r in app["replicas"]]
+
+    def delete(self, name: str):
+        import ray_tpu
+
+        app = self.apps.pop(name, None)
+        if app:
+            for h in app["replicas"]:
+                try:
+                    ray_tpu.kill(h)
+                except Exception:
+                    pass
+        return True
+
+    def list_deployments(self):
+        return {name: len(app["replicas"]) for name, app in self.apps.items()}
+
+
+# ------------------------------------------------------------------ handle
+
+
+class DeploymentHandle:
+    """Client-side router: least-outstanding-requests replica choice
+    (reference: router.py assign_request + pow_2_scheduler.py)."""
+
+    def __init__(self, name: str, replica_ids: List[str]):
+        self._name = name
+        from ray_tpu.api import ActorHandle
+
+        self._replicas = [ActorHandle(rid) for rid in replica_ids]
+        self._inflight = [0] * len(self._replicas)
+        self._lock = threading.Lock()
+
+    def remote(self, *args, _method: str = "__call__", **kwargs):
+        import ray_tpu
+
+        with self._lock:
+            idx = min(range(len(self._replicas)),
+                      key=lambda i: self._inflight[i])
+            self._inflight[idx] += 1
+        ref = self._replicas[idx].handle_request.remote(_method, args, kwargs)
+
+        def _done_cb():
+            with self._lock:
+                self._inflight[idx] -= 1
+
+        _watch_ref(ref, _done_cb)
+        return ref
+
+    def method(self, name: str):
+        def call(*args, **kwargs):
+            return self.remote(*args, _method=name, **kwargs)
+
+        return call
+
+
+def _watch_ref(ref, cb):
+    def watcher():
+        import ray_tpu
+
+        try:
+            ray_tpu.wait([ref], num_returns=1, timeout=600)
+        except Exception:
+            pass
+        cb()
+
+    threading.Thread(target=watcher, daemon=True).start()
+
+
+# ---------------------------------------------------------------- serve API
+
+
+def _controller():
+    import ray_tpu
+
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        pass
+    import ray_tpu.api as api
+
+    try:
+        return api.ActorClass(ServeController, name=CONTROLLER_NAME,
+                              lifetime="detached").remote()
+    except ray_tpu.RayError:
+        # lost the creation race to another caller
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+
+def run(app: Application, name: Optional[str] = None) -> DeploymentHandle:
+    import cloudpickle
+
+    import ray_tpu
+
+    d = app.deployment
+    dep_name = name or d.name
+    ctrl = _controller()
+    ray_tpu.get(ctrl.deploy.remote(
+        dep_name, cloudpickle.dumps(d.func_or_class), d.num_replicas,
+        d.max_ongoing_requests, d.init_args, d.init_kwargs,
+        d.ray_actor_options), timeout=600)
+    return get_handle(dep_name)
+
+
+def get_handle(name: str) -> DeploymentHandle:
+    import ray_tpu
+
+    ctrl = _controller()
+    replica_ids = ray_tpu.get(ctrl.get_replicas.remote(name), timeout=60)
+    if replica_ids is None:
+        raise ValueError(f"no deployment named {name!r}")
+    return DeploymentHandle(name, replica_ids)
+
+
+def delete(name: str):
+    import ray_tpu
+
+    ray_tpu.get(_controller().delete.remote(name), timeout=120)
+
+
+def shutdown():
+    import ray_tpu
+
+    try:
+        ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return
+    for name in list(ray_tpu.get(ctrl.list_deployments.remote(), timeout=60)):
+        ray_tpu.get(ctrl.delete.remote(name), timeout=120)
+    ray_tpu.kill(ctrl)

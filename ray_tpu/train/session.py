@@ -34,6 +34,11 @@ class TrainContext:
         return self.local_rank
 
 
+class StopTrial(Exception):
+    """Raised inside report() when the controller requested a stop
+    (reference: function_trainable.py StopCallback semantics)."""
+
+
 class _Session:
     def __init__(self, ctx: TrainContext,
                  checkpoint_to_restore: Optional[str] = None,
@@ -44,10 +49,13 @@ class _Session:
         self.checkpoint_to_restore = checkpoint_to_restore
         self.datasets = datasets or {}
         self.finished = threading.Event()
+        self.stop_requested = threading.Event()
         self.error: Optional[BaseException] = None
         self.final: Any = None
 
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[str]):
+        if self.stop_requested.is_set():
+            raise StopTrial()
         with self.lock:
             self.reports.append({"metrics": dict(metrics),
                                  "checkpoint": checkpoint})

@@ -74,12 +74,16 @@ class TrainWorker:
         self._session = session
 
         def target():
+            from ray_tpu.train.session import StopTrial
+
             _set_session(session)
             try:
                 if config is not None:
                     session.final = fn(config)
                 else:
                     session.final = fn()
+            except StopTrial:
+                pass  # controller-requested early stop: clean exit
             except BaseException as e:  # reported via poll()
                 session.error = e
                 session.reports.append(
@@ -106,6 +110,12 @@ class TrainWorker:
                 err = cloudpickle.dumps(RuntimeError(str(s.error)))
         return {"done": done, "reports": s.drain(), "error": err,
                 "final": s.final if done and s.error is None else None}
+
+    def request_stop(self) -> bool:
+        """Ask the running loop to stop at its next report()."""
+        if self._session is not None:
+            self._session.stop_requested.set()
+        return True
 
     def shutdown_worker(self) -> bool:
         return True

@@ -1,0 +1,81 @@
+"""Trial schedulers: FIFO and ASHA early stopping.
+
+Equivalent of the reference's schedulers
+(reference: python/ray/tune/schedulers/async_hyperband.py ASHA,
+trial_scheduler.py decision protocol): on_result returns CONTINUE or
+STOP; ASHA prunes trials that fall below the top fraction at each rung.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str) -> None:
+        pass
+
+
+class ASHAScheduler:
+    """Asynchronous Successive Halving.
+
+    Rungs at max_t / reduction_factor^k; a trial reaching a rung is
+    stopped unless its metric is in the top 1/reduction_factor of all
+    results recorded at that rung so far.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4,
+                 time_attr: str = "training_iteration"):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        # rung milestone -> list of recorded metric values
+        self.rungs: Dict[int, List[float]] = {}
+        milestones = []
+        t = grace_period
+        while t < max_t:
+            milestones.append(t)
+            t *= reduction_factor
+        self.milestones = milestones
+        self._trial_rungs: Dict[str, int] = {}
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP  # budget exhausted (not a pruning decision)
+        decision = CONTINUE
+        for rung in self.milestones:
+            if t >= rung and self._trial_rungs.get(trial_id, -1) < rung:
+                self._trial_rungs[trial_id] = rung
+                recorded = self.rungs.setdefault(rung, [])
+                recorded.append(float(value))
+                if not self._in_top_fraction(float(value), recorded):
+                    decision = STOP
+        return decision
+
+    def _in_top_fraction(self, value: float, recorded: List[float]) -> bool:
+        if len(recorded) < self.rf:
+            return True  # not enough evidence to prune yet
+        k = max(1, math.floor(len(recorded) / self.rf))
+        ordered = sorted(recorded, reverse=(self.mode == "max"))
+        cutoff = ordered[k - 1]
+        return value <= cutoff if self.mode == "min" else value >= cutoff
+
+    def on_trial_complete(self, trial_id: str) -> None:
+        self._trial_rungs.pop(trial_id, None)

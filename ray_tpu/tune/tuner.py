@@ -1,0 +1,171 @@
+"""Tuner + trial controller.
+
+Equivalent of the reference's Tuner/TuneController
+(reference: python/ray/tune/tuner.py; execution/tune_controller.py:69 —
+step :667 launches trial actors, dispatches train, reacts to results).
+Trials reuse the TrainWorker actor (run_async/poll/request_stop), so a
+trial IS a 1-worker training run — mirroring the reference where Train
+execution *is* Tune execution (base_trainer.py:567).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
+from ray_tpu.tune.search import generate_variants
+
+
+@dataclass
+class TuneConfig:
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    metric: str = "loss"
+    mode: str = "min"
+    scheduler: Optional[Any] = None
+    seed: Optional[int] = None
+
+
+@dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+    state: str = "PENDING"  # RUNNING/TERMINATED/STOPPED/ERROR
+    error: Optional[str] = None
+    checkpoint: Optional[str] = None
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult], metric: str, mode: str):
+        self.results = results
+        self._metric = metric
+        self._mode = mode
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        scored = [r for r in self.results
+                  if r.state in ("TERMINATED", "STOPPED")
+                  and metric in r.metrics]
+        if not scored:
+            raise ValueError("no successful trial reported "
+                             f"metric {metric!r}")
+        return (min if mode == "min" else max)(
+            scored, key=lambda r: r.metrics[metric])
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for r in self.results:
+            row = {"trial_id": r.trial_id, "state": r.state, **r.metrics}
+            row.update({f"config/{k}": v for k, v in r.config.items()})
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+    def __len__(self):
+        return len(self.results)
+
+    def __iter__(self):
+        return iter(self.results)
+
+
+class _Trial:
+    def __init__(self, trial_id: str, config: Dict[str, Any]):
+        self.id = trial_id
+        self.config = config
+        self.result = TrialResult(trial_id, config)
+        self.actor = None
+        self.iteration = 0
+        self.stopping = False
+
+
+class Tuner:
+    def __init__(self, trainable: Callable[[Dict[str, Any]], Any],
+                 *, param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 resources_per_trial: Optional[Dict[str, float]] = None):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.resources_per_trial = resources_per_trial
+
+    def fit(self) -> ResultGrid:
+        import ray_tpu
+        from ray_tpu.train.worker_group import TrainWorker
+
+        tc = self.tune_config
+        scheduler = tc.scheduler or FIFOScheduler()
+        variants = generate_variants(self.param_space, tc.num_samples, tc.seed)
+        trials = [_Trial(f"trial_{i:05d}", cfg)
+                  for i, cfg in enumerate(variants)]
+        cap = tc.max_concurrent_trials or min(8, max(1, len(trials)))
+        fn_blob = cloudpickle.dumps(self.trainable)
+        actor_cls = ray_tpu.remote(TrainWorker)
+        if self.resources_per_trial:
+            actor_cls = actor_cls.options(resources=self.resources_per_trial)
+
+        pending = list(trials)
+        running: List[_Trial] = []
+        finished: List[_Trial] = []
+        while pending or running:
+            # launch up to the concurrency cap
+            # (reference: _schedule_trial_actor tune_controller.py:965)
+            while pending and len(running) < cap:
+                t = pending.pop(0)
+                t.actor = actor_cls.remote(0, 1)
+                t.result.state = "RUNNING"
+                ray_tpu.get(t.actor.run_async.remote(fn_blob, t.config),
+                            timeout=120)
+                running.append(t)
+            time.sleep(0.02)
+            for t in list(running):
+                try:
+                    poll = ray_tpu.get(t.actor.poll.remote(), timeout=60)
+                except ray_tpu.RayError as e:
+                    t.result.state = "ERROR"
+                    t.result.error = str(e)
+                    running.remove(t)
+                    finished.append(t)
+                    continue
+                self._ingest(t, poll, scheduler)
+                if poll["done"]:
+                    if poll["error"] is not None and t.result.state != "STOPPED":
+                        t.result.state = "ERROR"
+                        t.result.error = repr(cloudpickle.loads(poll["error"]))
+                    elif t.result.state == "RUNNING":
+                        t.result.state = "TERMINATED"
+                    scheduler.on_trial_complete(t.id)
+                    running.remove(t)
+                    finished.append(t)
+                    ray_tpu.kill(t.actor)
+        return ResultGrid([t.result for t in finished], tc.metric, tc.mode)
+
+    def _ingest(self, t: _Trial, poll: Dict[str, Any], scheduler) -> None:
+        import ray_tpu
+
+        for rep in poll["reports"]:
+            metrics = rep["metrics"]
+            if "_error" in metrics:
+                continue
+            t.iteration += 1
+            metrics = dict(metrics)
+            metrics.setdefault("training_iteration", t.iteration)
+            t.result.metrics = metrics
+            t.result.metrics_history.append(metrics)
+            if rep.get("checkpoint"):
+                t.result.checkpoint = rep["checkpoint"]
+            if not t.stopping and scheduler.on_result(t.id, metrics) == STOP:
+                t.stopping = True
+                t.result.state = "STOPPED"
+                try:
+                    ray_tpu.get(t.actor.request_stop.remote(), timeout=30)
+                except ray_tpu.RayError:
+                    pass

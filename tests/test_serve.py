@@ -1,0 +1,116 @@
+"""ray_tpu.serve tests (reference: python/ray/serve/tests/ unit patterns)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    try:
+        yield ray_tpu
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+
+
+def test_function_deployment(cluster):
+    @serve.deployment
+    def echo(x):
+        return {"echo": x}
+
+    handle = serve.run(echo.bind())
+    assert ray_tpu.get(handle.remote("hi"), timeout=60) == {"echo": "hi"}
+    serve.delete("echo")
+
+
+def test_class_deployment_with_state(cluster):
+    @serve.deployment(name="adder")
+    class Adder:
+        def __init__(self, base):
+            self.base = base
+
+        def __call__(self, x):
+            return self.base + x
+
+        def describe(self):
+            return f"adder+{self.base}"
+
+    handle = serve.run(Adder.bind(10))
+    assert ray_tpu.get(handle.remote(5), timeout=60) == 15
+    assert ray_tpu.get(handle.method("describe")(), timeout=30) == "adder+10"
+    serve.delete("adder")
+
+
+def test_multi_replica_load_balancing(cluster):
+    @serve.deployment(name="pids", num_replicas=3)
+    class Pids:
+        def __call__(self, _):
+            import os
+            import time as _t
+
+            _t.sleep(0.15)
+            return os.getpid()
+
+    handle = serve.run(Pids.bind())
+    refs = [handle.remote(i) for i in range(9)]
+    pids = set(ray_tpu.get(refs, timeout=120))
+    assert len(pids) >= 2  # requests spread across replicas
+    serve.delete("pids")
+
+
+def test_dynamic_batching(cluster):
+    @serve.deployment(name="batcher", max_ongoing_requests=16)
+    class Model:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.2)
+        def forward(self, items):
+            self.batch_sizes.append(len(items))
+            return [x * 2 for x in items]
+
+        def __call__(self, x):
+            return self.forward(x)
+
+        def stats(self):
+            return self.batch_sizes
+
+    handle = serve.run(Model.bind())
+    refs = [handle.remote(i) for i in range(8)]
+    out = ray_tpu.get(refs, timeout=120)
+    assert sorted(out) == [i * 2 for i in range(8)]
+    sizes = ray_tpu.get(handle.method("stats")(), timeout=30)
+    assert max(sizes) > 1, f"no batching happened: {sizes}"
+    serve.delete("batcher")
+
+
+def test_redeploy_scales(cluster):
+    @serve.deployment(name="scaled", num_replicas=1)
+    def f(x):
+        return x
+
+    serve.run(f.bind())
+    handle = serve.run(f.options(num_replicas=2).bind(), name="scaled")
+    assert len(handle._replicas) == 2
+    serve.delete("scaled")
+
+
+def test_get_handle_and_delete(cluster):
+    @serve.deployment(name="tmp")
+    def g(x):
+        return x + 1
+
+    serve.run(g.bind())
+    h = serve.get_handle("tmp")
+    assert ray_tpu.get(h.remote(1), timeout=60) == 2
+    serve.delete("tmp")
+    with pytest.raises(ValueError):
+        serve.get_handle("tmp")

@@ -1,0 +1,109 @@
+"""ray_tpu.tune tests (reference: python/ray/tune/tests/ patterns —
+mock-fast trainables, deterministic search spaces)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    try:
+        yield ray_tpu
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_grid_search_finds_best(cluster):
+    def trainable(config):
+        from ray_tpu import train
+
+        score = (config["x"] - 3) ** 2
+        train.report({"score": score})
+        return score
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([0, 1, 2, 3, 4])},
+        tune_config=tune.TuneConfig(metric="score", mode="min"),
+    )
+    results = tuner.fit()
+    assert len(results) == 5
+    best = results.get_best_result()
+    assert best.config["x"] == 3
+    assert best.metrics["score"] == 0
+
+
+def test_random_sampling_and_seed(cluster):
+    variants = tune.search.generate_variants(
+        {"lr": tune.loguniform(1e-5, 1e-1), "b": tune.choice([1, 2])},
+        num_samples=4, seed=0)
+    again = tune.search.generate_variants(
+        {"lr": tune.loguniform(1e-5, 1e-1), "b": tune.choice([1, 2])},
+        num_samples=4, seed=0)
+    assert variants == again
+    assert len(variants) == 4
+    assert all(1e-5 <= v["lr"] <= 1e-1 for v in variants)
+
+
+def test_asha_prunes_bad_trials(cluster):
+    def trainable(config):
+        import time as _t
+
+        from ray_tpu import train
+
+        for step in range(1, 21):
+            # bad trials plateau high; good ones descend
+            loss = config["quality"] * 10.0 / step
+            train.report({"loss": loss, "training_iteration": step})
+            _t.sleep(0.005)
+        return True
+
+    scheduler = tune.ASHAScheduler(metric="loss", mode="min", max_t=20,
+                                   grace_period=2, reduction_factor=2)
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"quality": tune.grid_search([1, 1, 8, 8, 8, 8])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    scheduler=scheduler,
+                                    max_concurrent_trials=6),
+    )
+    results = tuner.fit()
+    states = [r.state for r in results]
+    assert "STOPPED" in states  # some bad trial was pruned early
+    best = results.get_best_result()
+    assert best.config["quality"] == 1
+
+
+def test_trial_error_recorded(cluster):
+    def trainable(config):
+        if config["x"] == 1:
+            raise ValueError("bad trial")
+        from ray_tpu import train
+
+        train.report({"ok": 1})
+        return True
+
+    results = tune.Tuner(
+        trainable, param_space={"x": tune.grid_search([0, 1])},
+        tune_config=tune.TuneConfig(metric="ok", mode="max")).fit()
+    by_x = {r.config["x"]: r for r in results}
+    assert by_x[0].state == "TERMINATED"
+    assert by_x[1].state == "ERROR"
+    assert "bad trial" in by_x[1].error
+
+
+def test_result_dataframe(cluster):
+    def trainable(config):
+        from ray_tpu import train
+
+        train.report({"m": config["x"] * 2})
+
+    results = tune.Tuner(
+        trainable, param_space={"x": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="m", mode="max")).fit()
+    df = results.get_dataframe()
+    assert set(df["config/x"]) == {1, 2}
+    assert set(df["m"]) == {2, 4}
