@@ -78,7 +78,8 @@ class _PgEntry:
 class _ActorEntry:
     __slots__ = ("actor_id", "spec_wire", "state", "node_id", "worker_id",
                  "addr", "instance", "restarts_left", "name", "waiters",
-                 "death_cause", "kill_requested")
+                 "death_cause", "kill_requested", "sched_gen", "sched_node",
+                 "sched_task")
 
     def __init__(self, actor_id: str, spec_wire: Dict[str, Any], name: str,
                  max_restarts: int):
@@ -94,6 +95,12 @@ class _ActorEntry:
         self.name = name
         self.waiters: List[asyncio.Event] = []
         self.death_cause = ""
+        # scheduling ownership: only the coroutine holding the current
+        # generation may mutate this actor's state; sched_node/sched_task
+        # let node-death tear down an in-flight creation push
+        self.sched_gen = 0
+        self.sched_node: str = ""
+        self.sched_task: Optional[asyncio.Task] = None
 
     def info(self) -> Dict[str, Any]:
         return {
@@ -256,7 +263,15 @@ class HeadService(RpcHost):
             await entry.client.close()
         # restart or fail every actor that lived on that node
         for actor in list(self.actors.values()):
-            if actor.node_id == node_id and actor.state in (ALIVE, PENDING, RESTARTING):
+            if (actor.state in (PENDING, RESTARTING)
+                    and actor.sched_node == node_id):
+                # an in-flight creation push targets the dead node; the RPC
+                # may hang forever (silent host death) — abort the attempt
+                # and reschedule without spending the restart budget
+                if actor.sched_task is not None:
+                    actor.sched_task.cancel()
+                self._spawn_scheduler(actor)
+            elif actor.node_id == node_id and actor.state in (ALIVE, PENDING):
                 await self._on_actor_worker_lost(
                     actor, f"node {node_id[:8]} died: {reason}")
         await self._on_pg_node_dead(node_id)
@@ -294,7 +309,7 @@ class HeadService(RpcHost):
             self.named_actors[name] = ts.actor_id
         entry = _ActorEntry(ts.actor_id, spec, name, ts.max_restarts)
         self.actors[ts.actor_id] = entry
-        asyncio.ensure_future(self._schedule_actor(entry))
+        self._spawn_scheduler(entry)
         return {"actor_id": ts.actor_id}
 
     async def rpc_get_actor_info(self, actor_id: str, wait: bool = False,
@@ -362,6 +377,11 @@ class HeadService(RpcHost):
         return {"ok": True}
 
     async def _on_actor_worker_lost(self, actor: _ActorEntry, cause: str):
+        if actor.state == RESTARTING:
+            # a restart is already in flight (_schedule_actor retries node
+            # failures itself); a second concurrent reschedule would double
+            # -decrement restarts_left and leak a live instance on a lease
+            return
         if actor.restarts_left == 0:
             actor.state = DEAD
             actor.death_cause = cause
@@ -373,14 +393,26 @@ class HeadService(RpcHost):
             actor.restarts_left -= 1
         actor.state = RESTARTING
         actor.wake()
-        asyncio.ensure_future(self._schedule_actor(actor))
+        self._spawn_scheduler(actor)
 
-    async def _schedule_actor(self, actor: _ActorEntry):
+    def _spawn_scheduler(self, actor: _ActorEntry):
+        """Start a new scheduling attempt, invalidating any older one."""
+        actor.sched_gen += 1
+        actor.sched_node = ""
+        asyncio.ensure_future(self._schedule_actor(actor, actor.sched_gen))
+
+    async def _schedule_actor(self, actor: _ActorEntry, gen: int = 0):
         """Pick a node, lease a worker there, push the creation task.
+
+        Only the coroutine holding the actor's current sched_gen may mutate
+        its state — a newer attempt (spawned by worker/node death handlers)
+        silently retires this one.
 
         Reference: gcs_actor_scheduler.h — GCS leases workers from raylets
         using the same protocol normal tasks do.
         """
+        gen = gen or actor.sched_gen
+        actor.sched_task = asyncio.current_task()
         ts = TaskSpec.from_wire(actor.spec_wire)
         demand = ts.resource_set()
         delay = 0.05
@@ -388,7 +420,8 @@ class HeadService(RpcHost):
             # waiting for the group to be placed must not consume the
             # creation retry budget — PGs may stay PENDING for a while
             while True:
-                if actor.kill_requested or actor.state == DEAD:
+                if (actor.kill_requested or actor.state == DEAD
+                        or actor.sched_gen != gen):
                     return
                 pg = self.placement_groups.get(ts.placement_group_id)
                 if pg is None:
@@ -408,7 +441,8 @@ class HeadService(RpcHost):
                 await asyncio.sleep(delay)
                 delay = min(delay * 2, 1.0)
         for attempt in range(config.actor_creation_retries + 1):
-            if actor.kill_requested or actor.state == DEAD:
+            if (actor.kill_requested or actor.state == DEAD
+                    or actor.sched_gen != gen):
                 return
             if ts.placement_group_id:
                 pg = self.placement_groups.get(ts.placement_group_id)
@@ -450,29 +484,46 @@ class HeadService(RpcHost):
             # constructor may legitimately run for a long time (model
             # load), so use the task-push timeout, not the RPC default
             wclient = RpcClient(g["addr"][0], g["addr"][1], label="actor-create")
+            actor.sched_node = nid
             try:
                 reply = await wclient.call(
                     "push_task", spec=actor.spec_wire, instance=actor.instance + 1,
                     timeout=7 * 86400.0)
                 if reply.get("error"):
                     raise RpcError(f"actor constructor failed: {reply['error_str']}")
+            except asyncio.CancelledError:
+                # a node-death handler aborted this attempt and respawned a
+                # fresh one; the lease died with the node
+                await wclient.close()
+                return
             except RpcError as e:
+                await wclient.close()
+                await _drop_lease()
+                if actor.sched_gen != gen:
+                    return
                 # constructor raised: do not retry onto other nodes
                 actor.state = DEAD
                 actor.death_cause = str(e)
                 if actor.name:
                     self.named_actors.pop(actor.name, None)
                 actor.wake()
-                await wclient.close()
-                await _drop_lease()
                 return
             except Exception:
                 # transport failure: give the lease back before retrying
                 await wclient.close()
                 await _drop_lease()
+                if actor.sched_gen != gen:
+                    return
                 await asyncio.sleep(delay)
                 continue
+            finally:
+                actor.sched_node = ""
             await wclient.close()
+            if actor.sched_gen != gen:
+                # a newer scheduling attempt owns this actor now; this
+                # instance is orphaned — tear it down
+                await _drop_lease()
+                return
             if actor.kill_requested:
                 # killed while the constructor ran: tear the instance down
                 actor.state = DEAD

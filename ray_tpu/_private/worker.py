@@ -80,6 +80,7 @@ class _ExecState(threading.local):
     task_id: str = ""
     job_id: str = ""
     put_index: int = 0
+    num_returns: int = 0
 
 
 class _TaskState:
@@ -339,7 +340,9 @@ class CoreWorker(RpcHost):
     def _next_put_oid(self) -> str:
         with self._put_lock:
             self._put_counter += 1
-            idx = 100 + self._put_counter  # return indices stay below 100
+            # put indices live above the current task's return indices
+            # (tasks may declare >99 returns, e.g. random_shuffle blocks)
+            idx = max(100, self._exec.num_returns + 1) + self._put_counter
         tid = TaskID.from_hex(self._exec.task_id or
                               TaskID.for_driver(JobID.from_hex(self.job_id)).hex())
         return ObjectID.from_index(tid, idx).hex()
@@ -1045,6 +1048,7 @@ class CoreWorker(RpcHost):
         spec = TaskSpec.from_wire(spec_wire)
         self._exec.task_id = spec.task_id
         self._exec.job_id = spec.job_id
+        self._exec.num_returns = spec.num_returns
         try:
             args, kwargs, arg_ref_oids = self._materialize_args(spec)
         except BaseException as e:
@@ -1139,7 +1143,12 @@ class CoreWorker(RpcHost):
             reply["nested"] = nested
             reply["needs_ack"] = True
             self._pending_acks[spec.task_id] = held
-            self._loop().call_later(60.0, lambda: self._pending_acks.pop(spec.task_id, None))
+            # this runs on a task-execution thread; asyncio loops only allow
+            # timer scheduling from the loop thread itself
+            loop = self._loop()
+            loop.call_soon_threadsafe(
+                loop.call_later, 60.0,
+                lambda: self._pending_acks.pop(spec.task_id, None))
         return reply
 
     def _error_reply(self, spec: TaskSpec, exc: BaseException, tb: str) -> Dict[str, Any]:

@@ -21,10 +21,35 @@ from typing import Any, Callable, Dict, List, Optional
 
 CONTROLLER_NAME = "_serve_controller"
 
-_batch_init_lock = threading.Lock()
-
-
 # ----------------------------------------------------------------- batching
+
+# Batch state lives ONLY in this per-process registry, never on the user's
+# class or instance: a _BatchState holds threading locks, and anything
+# reachable from the decorated class must stay cloudpickle-able (the
+# deployment ships the class to replicas by value).  Keyed by
+# (id(owner), key); a weakref finalizer evicts the entry when the owner is
+# collected, so short-lived instances don't leak state and a recycled id()
+# can't adopt a dead owner's batches.
+_batch_states: Dict[Any, "_BatchState"] = {}
+_batch_states_lock = threading.Lock()
+
+
+def _batch_state_for(owner, key: str, max_batch_size: int,
+                     wait_s: float) -> "_BatchState":
+    import weakref
+
+    regkey = (id(owner), key)
+    with _batch_states_lock:
+        state = _batch_states.get(regkey)
+        if state is None:
+            state = _BatchState(max_batch_size, wait_s)
+            _batch_states[regkey] = state
+            try:
+                weakref.finalize(owner, _batch_states.pop, regkey, None)
+            except TypeError:
+                # owner not weakref-able: state lives for the process
+                pass
+        return state
 
 
 def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 8,
@@ -37,19 +62,8 @@ def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 8,
     """
 
     def wrap(fn: Callable) -> Callable:
-        # state is created lazily per process/instance: a _BatchState
-        # holds locks, which would make the decorated class unpicklable
         cfg = (max_batch_size, batch_wait_timeout_s)
-        state_key = f"_serve_batch_state_{getattr(fn, '__name__', 'fn')}"
-
-        def _state_for(owner) -> "_BatchState":
-            with _batch_init_lock:
-                holder = owner if owner is not None else wrapped
-                state = getattr(holder, state_key, None)
-                if state is None:
-                    state = _BatchState(*cfg)
-                    setattr(holder, state_key, state)
-                return state
+        state_key = f"_serve_batch_{getattr(fn, '__name__', 'fn')}"
 
         def wrapped(self_or_item, *maybe_item):
             # support methods (self, item) and free functions (item)
@@ -57,9 +71,11 @@ def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 8,
                 owner, item = self_or_item, maybe_item[0]
                 call = lambda items: fn(owner, items)
             else:
-                owner, item = None, self_or_item
+                # free function: the wrapper itself anchors the state
+                owner, item = wrapped, self_or_item
                 call = fn
-            return _state_for(owner).submit(item, call)
+            state = _batch_state_for(owner, state_key, *cfg)
+            return state.submit(item, call)
 
         wrapped.__name__ = getattr(fn, "__name__", "batched")
         wrapped._is_serve_batch = True

@@ -120,10 +120,23 @@ class Tuner:
             # (reference: _schedule_trial_actor tune_controller.py:965)
             while pending and len(running) < cap:
                 t = pending.pop(0)
-                t.actor = actor_cls.remote(0, 1)
-                t.result.state = "RUNNING"
-                ray_tpu.get(t.actor.run_async.remote(fn_blob, t.config),
-                            timeout=120)
+                try:
+                    t.actor = actor_cls.remote(0, 1)
+                    t.result.state = "RUNNING"
+                    ray_tpu.get(t.actor.run_async.remote(fn_blob, t.config),
+                                timeout=120)
+                except ray_tpu.RayError as e:
+                    # placement failure must cost only this trial, not the
+                    # whole experiment's completed results
+                    t.result.state = "ERROR"
+                    t.result.error = str(e)
+                    if t.actor is not None:
+                        try:
+                            ray_tpu.kill(t.actor)
+                        except Exception:
+                            pass
+                    finished.append(t)
+                    continue
                 running.append(t)
             time.sleep(0.02)
             for t in list(running):
