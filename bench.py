@@ -78,15 +78,21 @@ def bench_put_gbps(ray_tpu, mb=100, iters=5):
 
 def _train_bench_loop():
     """Runs inside a worker actor; imports jax there (claims the chip)."""
+    import dataclasses
+
     import jax
 
     platform = jax.devices()[0].platform
     from ray_tpu.models.llama import LlamaConfig
-    from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+    from ray_tpu.parallel.mesh import MeshSpec, make_mesh, shard_batch
     from ray_tpu.train.gspmd import build_llama_train_state, param_count
 
     if platform == "tpu":
-        cfg, batch, seq, steps = LlamaConfig.small(), 8, 1024, 20
+        # ~600M params fills the v5e MXU; remat leaves HBM headroom
+        # (measured 52.5% MFU at this point; no-remat is 53.1% but runs
+        # within ~1.5 GB of the 16 GB limit)
+        cfg = dataclasses.replace(LlamaConfig.bench_1b(), remat=True)
+        batch, seq, steps = 8, 1024, 20
     else:
         cfg, batch, seq, steps = LlamaConfig.tiny(), 4, 128, 5
     mesh = make_mesh(MeshSpec(dp=-1), devices=jax.devices()[:1])
@@ -94,12 +100,14 @@ def _train_bench_loop():
         cfg, mesh, batch_size=batch, seq_len=seq)
     tokens = jax.random.randint(jax.random.PRNGKey(0), (batch, seq), 0,
                                 cfg.vocab_size, dtype="int32")
-    params, opt, loss = step_fn(params, opt, tokens)  # compile
-    jax.block_until_ready(loss)
+    tokens = shard_batch(mesh, tokens)  # place once, outside the loop
+    for _ in range(3):  # compile + settle donation aliasing
+        params, opt, loss = step_fn(params, opt, tokens)
+    float(loss)  # hard sync (block_until_ready is lazy over the tunnel)
     t0 = time.perf_counter()
     for _ in range(steps):
         params, opt, loss = step_fn(params, opt, tokens)
-    jax.block_until_ready(loss)
+    float(loss)
     dt = time.perf_counter() - t0
     tokens_per_s = steps * batch * seq / dt
     n_params = param_count(params)

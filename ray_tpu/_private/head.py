@@ -517,7 +517,10 @@ class HeadService(RpcHost):
                 await asyncio.sleep(delay)
                 continue
             finally:
-                actor.sched_node = ""
+                if actor.sched_gen == gen:
+                    # only the owning generation may clear the in-flight
+                    # marker — a retired one would clobber the live attempt
+                    actor.sched_node = ""
             await wclient.close()
             if actor.sched_gen != gen:
                 # a newer scheduling attempt owns this actor now; this

@@ -39,6 +39,7 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    remat: bool = False  # rematerialize each block (activation checkpointing)
 
     @classmethod
     def tiny(cls) -> "LlamaConfig":
@@ -51,6 +52,14 @@ class LlamaConfig:
         """~110M params: single-chip bench size."""
         return cls(vocab_size=32000, dim=768, n_layers=12, n_heads=12,
                    n_kv_heads=4, hidden_dim=2048, max_seq_len=2048)
+
+    @classmethod
+    def bench_1b(cls) -> "LlamaConfig":
+        """~600M params sized for one v5e chip's HBM with adamw fp32
+        state: big enough to load the MXU (all matmul dims are multiples
+        of 128), small enough that params+moments+grads fit in 16 GB."""
+        return cls(vocab_size=32000, dim=1536, n_layers=20, n_heads=12,
+                   n_kv_heads=4, hidden_dim=4096, max_seq_len=2048)
 
     @classmethod
     def llama3_8b(cls) -> "LlamaConfig":
@@ -173,10 +182,17 @@ class LlamaModel(nn.Module):
                      param_dtype=jnp.float32, name="embed")(tokens)
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[1]), tokens.shape)
+        block_cls = Block
+        if cfg.remat:
+            # trade FLOPs for HBM: recompute block internals in the bwd
+            # pass, keeping only block boundaries resident
+            block_cls = nn.remat(Block, prevent_cse=False)
         for i in range(cfg.n_layers):
-            x = Block(cfg, self.kernel, name=f"layer_{i}")(x, positions)
+            x = block_cls(cfg, self.kernel, name=f"layer_{i}")(x, positions)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
-        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+        # bf16 matmul with fp32 accumulation: the biggest single matmul of
+        # the model must ride the MXU fast path (loss math upcasts after)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                           param_dtype=jnp.float32, name="lm_head")(x)
         return logits
 
@@ -204,9 +220,13 @@ def llama_param_rules() -> Dict[str, Any]:
 
 
 def causal_lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
-    """Next-token cross entropy with shifted targets."""
+    """Next-token cross entropy with shifted targets.
+
+    Upcasts to fp32 only here — the lm_head matmul stays bf16 — and uses
+    the one-hot-free formulation so no [B,S,V] one-hot materializes.
+    """
     targets = tokens[:, 1:]
-    logits = logits[:, :-1]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    logits = logits[:, :-1].astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - picked)

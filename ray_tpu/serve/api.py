@@ -47,8 +47,11 @@ def _batch_state_for(owner, key: str, max_batch_size: int,
             try:
                 weakref.finalize(owner, _batch_states.pop, regkey, None)
             except TypeError:
-                # owner not weakref-able: state lives for the process
-                pass
+                # owner not weakref-able (__slots__ without __weakref__):
+                # pin it so its id() can't be recycled into this entry —
+                # a process-lifetime leak is better than another
+                # instance silently adopting this owner's queued batches
+                state.owner_pin = owner
         return state
 
 
@@ -90,6 +93,7 @@ class _BatchState:
     def __init__(self, max_batch_size: int, wait_s: float):
         self.max = max_batch_size
         self.wait = wait_s
+        self.owner_pin = None  # set for non-weakref-able owners
         self.lock = threading.Lock()
         self.items: List[Any] = []
         self.futures: List[Any] = []
