@@ -85,9 +85,12 @@ class TaskSpec:
 
     def scheduling_class(self) -> tuple:
         """Tasks with the same shape share worker leases (reference:
-        SchedulingClassDescriptor in task_spec.h)."""
+        SchedulingClassDescriptor in task_spec.h keys on resources AND
+        function descriptor — including the function keeps per-class
+        service-time stats meaningful, so one fast function can't drag a
+        slow one into deep pipelining)."""
         return (ResourceSet(self.resources).key(), self.kind,
-                self.placement_group_id, self.bundle_index)
+                self.function_id, self.placement_group_id, self.bundle_index)
 
     def to_wire(self) -> Dict[str, Any]:
         return {

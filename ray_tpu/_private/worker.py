@@ -59,6 +59,8 @@ MODE_WORKER = "worker"
 
 _TASK_PUSH_TIMEOUT = 7 * 86400.0  # tasks may legitimately run for days
 _LEASE_LINGER_S = 0.2
+_LEASE_PIPELINE_DEPTH = 8  # pushes in flight per leased worker
+_PIPELINE_FAST_TASK_S = 0.02  # only pipeline onto leases this fast
 _MAX_LEASES_PER_CLASS = 16
 _MAX_ACTOR_INFLIGHT = 1000
 
@@ -100,7 +102,7 @@ class _TaskState:
 
 
 class _Lease:
-    __slots__ = ("lease_id", "worker_id", "addr", "agent_addr", "busy",
+    __slots__ = ("lease_id", "worker_id", "addr", "agent_addr", "inflight",
                  "linger_handle", "dead")
 
     def __init__(self, lease_id: str, worker_id: str, addr: Tuple[str, int],
@@ -109,18 +111,25 @@ class _Lease:
         self.worker_id = worker_id
         self.addr = addr
         self.agent_addr = agent_addr
-        self.busy: Optional[_TaskState] = None
+        # tasks pushed but not yet replied, in push order (the worker
+        # executes FIFO, so inflight[0] is the one actually running);
+        # pipelining > 1 deep hides the push RPC round-trip (reference:
+        # direct_task_transport.h pipelines lease requests + pushes)
+        self.inflight: deque = deque()
         self.linger_handle = None
         self.dead = False
 
 
 class _SchedState:
-    __slots__ = ("pending", "leases", "inflight_requests")
+    __slots__ = ("pending", "leases", "inflight_requests", "svc_s")
 
     def __init__(self):
         self.pending: deque = deque()
         self.leases: List[_Lease] = []
         self.inflight_requests = 0
+        # EWMA of this scheduling class's push round-trip time; unmeasured
+        # classes spread depth-1 across workers, proven-short ones pipeline
+        self.svc_s: Optional[float] = None
 
 
 class _ActorState:
@@ -606,17 +615,24 @@ class CoreWorker(RpcHost):
         task.contained_refs = []
 
     def _pump(self, state: _SchedState):
-        # hand pending tasks to idle leases
-        idle = [l for l in state.leases if l.busy is None and not l.dead]
-        while state.pending and idle:
-            lease = idle.pop()
+        # hand pending tasks to leases, shallowest pipeline first; depth 1
+        # for fresh/slow leases (spread across workers), deeper only once a
+        # lease has proven to serve short tasks (hide the push round-trip)
+        live = [l for l in state.leases if not l.dead]
+        depth = (_LEASE_PIPELINE_DEPTH
+                 if state.svc_s is not None
+                 and state.svc_s < _PIPELINE_FAST_TASK_S else 1)
+        while state.pending and live:
+            lease = min(live, key=lambda l: len(l.inflight))
+            if len(lease.inflight) >= depth:
+                break
             task = state.pending.popleft()
             self._assign(state, lease, task)
         if not state.pending:
             # no demand: linger-return every idle lease (a lease granted
             # after the queue drained would otherwise pin resources forever)
             for lease in state.leases:
-                if lease.busy is None and not lease.dead \
+                if not lease.inflight and not lease.dead \
                         and lease.linger_handle is None:
                     self._schedule_linger(state, lease)
             return
@@ -732,21 +748,31 @@ class CoreWorker(RpcHost):
                 return
 
     def _assign(self, state: _SchedState, lease: _Lease, task: _TaskState):
-        lease.busy = task
+        lease.inflight.append(task)
         if lease.linger_handle is not None:
             lease.linger_handle.cancel()
             lease.linger_handle = None
         self._spawn(self._push(state, lease, task))
 
     async def _push(self, state: _SchedState, lease: _Lease, task: _TaskState):
+        t0 = time.perf_counter()
+        depth0 = len(lease.inflight)  # position in the worker's FIFO
         try:
             c = await self._aclient_worker(lease.addr)
             reply = await c.call("push_task", spec=task.spec.to_wire(),
                                  timeout=_TASK_PUSH_TIMEOUT)
         except (ConnectionLost, RpcError, Exception) as e:
+            # only the task actually running (oldest in the worker's FIFO)
+            # is charged a retry; tasks merely queued behind it were never
+            # started and requeue for free
+            started = bool(lease.inflight) and lease.inflight[0] is task
+            try:
+                lease.inflight.remove(task)
+            except ValueError:
+                pass
             self._drop_lease(state, lease, kill=True)
-            if task.retries_left != 0:
-                if task.retries_left > 0:
+            if not started or task.retries_left != 0:
+                if started and task.retries_left > 0:
                     task.retries_left -= 1
                 await self._sleep(config.task_retry_delay_ms / 1000.0)
                 state.pending.appendleft(task)
@@ -756,8 +782,15 @@ class CoreWorker(RpcHost):
                     f"{task.spec.name or task.spec.function_id[:8]}: {e}"))
             self._pump(state)
             return
+        # this push waited behind depth0-1 earlier tasks, so per-task
+        # service is roughly rtt/depth0 (snapshotted at push time)
+        svc = (time.perf_counter() - t0) / depth0
+        state.svc_s = svc if state.svc_s is None else 0.5 * (state.svc_s + svc)
         await self._process_reply(task, reply, lease.addr)
-        lease.busy = None
+        try:
+            lease.inflight.remove(task)
+        except ValueError:
+            pass
         self._pump(state)
 
     async def _sleep(self, s: float):
@@ -771,7 +804,7 @@ class CoreWorker(RpcHost):
             _LEASE_LINGER_S, lambda: self._spawn(self._return_lease(state, lease)))
 
     async def _return_lease(self, state: _SchedState, lease: _Lease, kill=False):
-        if lease.busy is not None or lease.dead:
+        if lease.inflight or lease.dead:
             return
         lease.dead = True
         if lease in state.leases:
@@ -783,8 +816,9 @@ class CoreWorker(RpcHost):
             pass
 
     def _drop_lease(self, state: _SchedState, lease: _Lease, kill: bool):
+        if lease.dead:
+            return  # several pipelined pushes may fail on the same lease
         lease.dead = True
-        lease.busy = None
         if lease in state.leases:
             state.leases.remove(lease)
         self._spawn(self._notify_drop(lease, kill))
