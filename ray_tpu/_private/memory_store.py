@@ -74,6 +74,21 @@ class MemoryStore:
         with self._lock:
             self._entries.pop(oid, None)
 
+    def clear_resolution(self, oid: str) -> None:
+        """Flip a resolved entry back to pending IN PLACE, so existing
+        waiters (holding the entry object) block until the recomputed
+        value arrives.  A racing reader may still see the old resolution;
+        its fetch fails and it retries through the reconstruction path."""
+        with self._lock:
+            e = self._entries.get(oid)
+        if e is not None:
+            e.event.clear()
+            e.value = None
+            e.raw = None
+            e.error = None
+            e.in_plasma = False
+            e.node_addr = None
+
     # ---- consumer side -----------------------------------------------------
 
     def known(self, oid: str) -> bool:

@@ -61,6 +61,7 @@ _TASK_PUSH_TIMEOUT = 7 * 86400.0  # tasks may legitimately run for days
 _LEASE_LINGER_S = 0.2
 _LEASE_PIPELINE_DEPTH = 8  # pushes in flight per leased worker
 _PIPELINE_FAST_TASK_S = 0.02  # only pipeline onto leases this fast
+_MAX_RECONSTRUCTION_ROUNDS = 10  # get() retry rounds across object losses
 _MAX_LEASES_PER_CLASS = 16
 _MAX_ACTOR_INFLIGHT = 1000
 
@@ -101,9 +102,21 @@ class _TaskState:
         ]
 
 
+class _LineageEntry:
+    __slots__ = ("spec", "live", "attempts_left", "arg_pins")
+
+    def __init__(self, spec: TaskSpec, arg_pins: List[ObjectRef]):
+        self.spec = spec
+        self.live: Set[str] = set()   # plasma return oids with live refs
+        # reconstruction budget rides the task's retry budget (-1 = infinite,
+        # matching _push's retries_left semantics)
+        self.attempts_left = spec.max_retries
+        self.arg_pins = arg_pins      # holding the refs pins the arg values
+
+
 class _Lease:
     __slots__ = ("lease_id", "worker_id", "addr", "agent_addr", "inflight",
-                 "linger_handle", "dead")
+                 "linger_handle", "dead", "failed_head")
 
     def __init__(self, lease_id: str, worker_id: str, addr: Tuple[str, int],
                  agent_addr: Tuple[str, int]):
@@ -118,6 +131,8 @@ class _Lease:
         self.inflight: deque = deque()
         self.linger_handle = None
         self.dead = False
+        # snapshotted at death: the one task that was actually executing
+        self.failed_head: Optional[_TaskState] = None
 
 
 class _SchedState:
@@ -173,6 +188,14 @@ class CoreWorker(RpcHost):
         self.functions = FunctionManager(self.head)
         self._locations: Dict[str, Tuple[str, int]] = {}  # owned oid -> node
         self._containers: Dict[str, List[ObjectRef]] = {}  # outer -> inner pins
+        # lineage reconstruction (reference: object_recovery_manager.cc +
+        # task_manager.h resubmit): while a plasma-stored return of an owned
+        # normal task has live refs, keep its TaskSpec (and pin its arg
+        # refs) so a lost primary copy can be recomputed
+        self._lineage_lock = threading.Lock()
+        self._lineage: Dict[str, _LineageEntry] = {}      # task_id -> entry
+        self._lineage_by_oid: Dict[str, str] = {}         # oid -> task_id
+        self._reconstructing: Set[str] = set()            # task_ids in flight
         self._sched: Dict[tuple, _SchedState] = {}
         self._pg_cache: Dict[str, Any] = {}
         self._actors: Dict[str, _ActorState] = {}
@@ -271,6 +294,7 @@ class CoreWorker(RpcHost):
         """Owned object's refcount hit zero: drop the value everywhere."""
         if self._shutdown:
             return
+        self._drop_lineage(oid)
         self.memory.evict(oid)
         self._containers.pop(oid, None)  # releases nested pins via GC
         loc = self._locations.pop(oid, None)
@@ -293,9 +317,25 @@ class CoreWorker(RpcHost):
     async def rpc_remove_borrow(self, oid: str, borrower: List):
         self.rc.remove_borrower(oid, (borrower[0], borrower[1]))
 
-    async def rpc_fetch_object(self, oid: str, wait: float = 0.0):
+    async def rpc_fetch_object(self, oid: str, wait: float = 0.0,
+                               lost_at=None):
         """Owner-side object resolution for borrowers
-        (reference: ownership-based object directory)."""
+        (reference: ownership-based object directory).
+
+        `lost_at` is a borrower's report that the node we pointed it at
+        could not serve the object; if it matches our recorded location,
+        drop it and kick lineage reconstruction."""
+        if lost_at is not None:
+            loc = self._locations.get(oid)
+            ent = self.memory.peek(oid)
+            cur = loc or (ent.node_addr if ent is not None and ent.in_plasma
+                          else None)
+            if cur is not None and tuple(lost_at) == tuple(cur):
+                # _maybe_reconstruct clears locations + resolutions for
+                # every return of the producing task before resubmitting
+                if not self._maybe_reconstruct(oid):
+                    return {"unknown": True}
+                return {"pending": True}
         entry = self.memory.peek(oid)
         if entry is None and wait > 0 and self.memory.known(oid):
             e = self.memory._entry(oid)
@@ -372,38 +412,67 @@ class CoreWorker(RpcHost):
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
         out: List[Any] = [None] * len(refs)
-        plasma_fetch: List[Tuple[int, ObjectRef, Tuple[str, int]]] = []
-        for i, ref in enumerate(refs):
-            oid = ref.oid
-            if self.memory.known(oid):
-                remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
-                entry = self.memory.wait_ready(oid, remaining)
-                if entry is None:
-                    raise GetTimeoutError(f"timed out waiting for {oid[:16]}")
-                if entry.error is not None:
-                    raise entry.error
-                if entry.in_plasma:
-                    plasma_fetch.append((i, ref, entry.node_addr))
+        pending: List[Tuple[int, ObjectRef]] = list(enumerate(refs))
+        for _round in range(_MAX_RECONSTRUCTION_ROUNDS):
+            plasma_fetch: List[Tuple[int, ObjectRef, Tuple[str, int]]] = []
+            carry: List[Tuple[int, ObjectRef]] = []  # raced-clear retries
+            for i, ref in pending:
+                oid = ref.oid
+                if self.memory.known(oid):
+                    remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+                    entry = self.memory.wait_ready(oid, remaining)
+                    if entry is None:
+                        raise GetTimeoutError(f"timed out waiting for {oid[:16]}")
+                    if entry.error is not None:
+                        raise entry.error
+                    if entry.in_plasma:
+                        plasma_fetch.append((i, ref, entry.node_addr))
+                    elif entry.raw is None and entry.value is None:
+                        # raced clear_resolution (reconstruction started
+                        # between wait_ready and this read): go around
+                        carry.append((i, ref))
+                        continue
+                    else:
+                        if entry.value is None and entry.raw is not None:
+                            with SerializationContext():
+                                entry.value = serialization.deserialize(entry.raw)
+                        out[i] = entry.value
+                elif self.rc.is_freed(oid):
+                    raise ObjectFreedError(f"object {oid[:16]} was freed by its owner")
                 else:
-                    if entry.value is None and entry.raw is not None:
-                        with SerializationContext():
-                            entry.value = serialization.deserialize(entry.raw)
-                    out[i] = entry.value
-            elif self.rc.is_freed(oid):
-                raise ObjectFreedError(f"object {oid[:16]} was freed by its owner")
-            else:
-                node = ref.node_addr
-                if node is None and ref.owner_addr is not None \
-                        and tuple(ref.owner_addr) != self.address:
-                    node = self._resolve_via_owner(ref, deadline)
+                    node = ref.node_addr if _round == 0 else None
+                    if node is None and ref.owner_addr is not None \
+                            and tuple(ref.owner_addr) != self.address:
+                        node = self._resolve_via_owner(ref, deadline)
+                        if node is None:
+                            continue  # value already placed in out by resolver
                     if node is None:
-                        continue  # value already placed in out by resolver
-                if node is None:
-                    node = self._locations.get(oid, self.agent_addr)
-                plasma_fetch.append((i, ref, node))
-        if plasma_fetch:
-            self._fetch_plasma(plasma_fetch, out, deadline)
-        return out
+                        node = self._locations.get(oid, self.agent_addr)
+                    plasma_fetch.append((i, ref, node))
+            if not plasma_fetch:
+                if not carry:
+                    return out
+                pending = carry
+                continue
+            failures = self._fetch_plasma(plasma_fetch, out, deadline)
+            if not failures and not carry:
+                return out
+            # some plasma primaries are gone: reconstruct what we own,
+            # report borrower-visible losses to their owners, retry
+            pending = carry
+            for i, ref, node, err in failures:
+                if self._maybe_reconstruct(ref.oid):
+                    pending.append((i, ref))
+                elif ref.owner_addr is not None \
+                        and tuple(ref.owner_addr) != self.address \
+                        and self._report_lost_to_owner(ref, node, deadline):
+                    pending.append((i, ref))
+                else:
+                    raise ObjectLostError(
+                        f"object {ref.oid[:16]} was lost ({err}) and cannot "
+                        f"be reconstructed")
+        raise ObjectLostError(
+            f"gave up reconstructing after {_MAX_RECONSTRUCTION_ROUNDS} rounds")
 
     def _resolve_via_owner(self, ref: ObjectRef, deadline) -> Optional[Tuple[str, int]]:
         """Ask the owner where the object lives; may inline the value.
@@ -436,12 +505,35 @@ class CoreWorker(RpcHost):
                 return None
             return (r["plasma"][0], r["plasma"][1])
 
-    async def _afetch_from_owner(self, owner, oid: str, wait: float):
+    async def _afetch_from_owner(self, owner, oid: str, wait: float,
+                                 lost_at=None):
         c = await self._aclient_worker(owner)
         return await c.call("fetch_object", oid=oid, wait=wait,
+                            lost_at=list(lost_at) if lost_at else None,
                             timeout=wait + 20.0)
 
-    def _fetch_plasma(self, items, out: List[Any], deadline) -> None:
+    def _report_lost_to_owner(self, ref: ObjectRef, node, deadline) -> bool:
+        """Tell the owner its recorded location failed to serve the object.
+        Returns True if the owner is handling it (reconstruction underway
+        or a different location exists) — the caller then re-resolves."""
+        owner = tuple(ref.owner_addr)
+        remaining = None if deadline is None else deadline - time.monotonic()
+        if remaining is not None and remaining <= 0:
+            raise GetTimeoutError(
+                f"timed out while recovering {ref.oid[:16]}")
+        budget = 30.0 if remaining is None else min(30.0, remaining)
+        try:
+            r = self._io.run(
+                self._afetch_from_owner(owner, ref.oid, 0.0, lost_at=node),
+                timeout=budget)
+        except Exception:
+            return False
+        return not (r.get("unknown") or r.get("freed") or "error" in r)
+
+    def _fetch_plasma(self, items, out: List[Any], deadline) -> list:
+        """Localize + read plasma objects; fills `out` for successes and
+        returns [(i, ref, node, err)] for objects that could not be
+        localized (lost primaries — reconstruction candidates)."""
         # 1. make everything local (pulls run concurrently on the IO loop)
         async def _ensure_all():
             import asyncio
@@ -455,13 +547,24 @@ class CoreWorker(RpcHost):
             return await asyncio.gather(*coros, return_exceptions=True)
 
         replies = self._io.run(_ensure_all(), timeout=config.rpc_call_timeout_s + 30)
+        failures: List[Tuple[int, ObjectRef, Tuple[str, int], str]] = []
+        localized = []
         for (i, ref, node), r in zip(items, replies):
-            if isinstance(r, Exception) or not r.get("ok"):
-                err = r if isinstance(r, Exception) else r.get("error")
-                raise ObjectLostError(f"could not localize {ref.oid[:16]}: {err}")
+            if isinstance(r, Exception):
+                # transient transport trouble with our own agent is NOT
+                # evidence the primary is lost — don't trigger a duplicate
+                # re-execution for it
+                raise ObjectLostError(
+                    f"could not localize {ref.oid[:16]}: {r}") from r
+            if not r.get("ok"):
+                failures.append((i, ref, node, str(r.get("error"))))
+            else:
+                localized.append((i, ref))
+        if not localized:
+            return failures
         # 2. read them zero-copy from the local store
         remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
-        oids = [ref.oid for _, ref, _ in items]
+        oids = [ref.oid for _, ref in localized]
         with SerializationContext() as ctx:
             try:
                 values = self.plasma.get_values(oids, timeout=remaining)
@@ -470,8 +573,9 @@ class CoreWorker(RpcHost):
                     raise ObjectFreedError(str(e)) from e
                 raise ObjectLostError(str(e)) from e
         self._register_foreign_refs(ctx.refs)
-        for (i, _, _), v in zip(items, values):
+        for (i, _), v in zip(localized, values):
             out[i] = v
+        return failures
 
     def _register_foreign_refs(self, refs: List[ObjectRef]) -> None:
         """Register borrows for refs materialized out of fetched values."""
@@ -612,6 +716,8 @@ class CoreWorker(RpcHost):
     def _fail_task(self, task: _TaskState, error: BaseException):
         for oid in task.return_oids:
             self.memory.set_error(oid, error)
+        with self._lineage_lock:
+            self._reconstructing.discard(task.spec.task_id)
         task.contained_refs = []
 
     def _pump(self, state: _SchedState):
@@ -749,28 +855,29 @@ class CoreWorker(RpcHost):
 
     def _assign(self, state: _SchedState, lease: _Lease, task: _TaskState):
         lease.inflight.append(task)
+        pos = len(lease.inflight)  # this task's position in the FIFO
         if lease.linger_handle is not None:
             lease.linger_handle.cancel()
             lease.linger_handle = None
-        self._spawn(self._push(state, lease, task))
+        self._spawn(self._push(state, lease, task, pos))
 
-    async def _push(self, state: _SchedState, lease: _Lease, task: _TaskState):
+    async def _push(self, state: _SchedState, lease: _Lease, task: _TaskState,
+                    depth0: int = 1):
         t0 = time.perf_counter()
-        depth0 = len(lease.inflight)  # position in the worker's FIFO
         try:
             c = await self._aclient_worker(lease.addr)
             reply = await c.call("push_task", spec=task.spec.to_wire(),
                                  timeout=_TASK_PUSH_TIMEOUT)
         except (ConnectionLost, RpcError, Exception) as e:
-            # only the task actually running (oldest in the worker's FIFO)
-            # is charged a retry; tasks merely queued behind it were never
-            # started and requeue for free
-            started = bool(lease.inflight) and lease.inflight[0] is task
+            # only the task actually running (oldest in the worker's FIFO
+            # when it died) is charged a retry; tasks merely queued behind
+            # it were never started and requeue for free
+            self._drop_lease(state, lease, kill=True)
+            started = lease.failed_head is task
             try:
                 lease.inflight.remove(task)
             except ValueError:
                 pass
-            self._drop_lease(state, lease, kill=True)
             if not started or task.retries_left != 0:
                 if started and task.retries_left > 0:
                     task.retries_left -= 1
@@ -819,6 +926,9 @@ class CoreWorker(RpcHost):
         if lease.dead:
             return  # several pipelined pushes may fail on the same lease
         lease.dead = True
+        # snapshot which task was executing when the worker died — each
+        # failing _push compares against this, not the shifting deque head
+        lease.failed_head = lease.inflight[0] if lease.inflight else None
         if lease in state.leases:
             state.leases.remove(lease)
         self._spawn(self._notify_drop(lease, kill))
@@ -859,6 +969,8 @@ class CoreWorker(RpcHost):
             elif "stored" in r:
                 node = tuple(r["stored"]["node"])
                 self._locations[oid] = node
+                if task.spec.kind == NORMAL_TASK:
+                    self._record_lineage(task, oid)
                 self.memory.set_in_plasma(oid, node)
         for b_oid in reply.get("borrows") or []:
             self.rc.add_borrower(b_oid, worker_addr)
@@ -868,7 +980,61 @@ class CoreWorker(RpcHost):
                 await c.oneway("task_ack", task_id=task.spec.task_id)
             except Exception:
                 pass
+        with self._lineage_lock:
+            self._reconstructing.discard(task.spec.task_id)
         task.contained_refs = []  # release submission pins
+
+    # ------------------------------------------------- lineage reconstruction
+
+    def _record_lineage(self, task: _TaskState, oid: str) -> None:
+        with self._lineage_lock:
+            entry = self._lineage.get(task.spec.task_id)
+            if entry is None:
+                entry = _LineageEntry(task.spec, list(task.contained_refs))
+                self._lineage[task.spec.task_id] = entry
+            entry.live.add(oid)
+            self._lineage_by_oid[oid] = task.spec.task_id
+
+    def _drop_lineage(self, oid: str) -> None:
+        with self._lineage_lock:
+            tid = self._lineage_by_oid.pop(oid, None)
+            if tid is None:
+                return
+            entry = self._lineage.get(tid)
+            if entry is not None:
+                entry.live.discard(oid)
+                if not entry.live:
+                    self._lineage.pop(tid, None)  # arg pins released via GC
+
+    def _maybe_reconstruct(self, oid: str) -> bool:
+        """Resubmit the task that produced a lost plasma return.
+
+        Returns True if a reconstruction is (already) underway — callers
+        then re-wait on the object.  Reference:
+        src/ray/core_worker/object_recovery_manager.cc (recover via
+        TaskManager resubmit, bounded by the retry budget).
+        """
+        with self._lineage_lock:
+            tid = self._lineage_by_oid.get(oid)
+            if tid is None:
+                return False
+            entry = self._lineage.get(tid)
+            if entry is None:
+                return False
+            if tid in self._reconstructing:
+                return True
+            if entry.attempts_left == 0:
+                return False
+            if entry.attempts_left > 0:
+                entry.attempts_left -= 1
+            self._reconstructing.add(tid)
+            spec = entry.spec
+        task = _TaskState(spec, list(entry.arg_pins))
+        for roid in task.return_oids:
+            self._locations.pop(roid, None)
+            self.memory.clear_resolution(roid)
+        self._spawn(self._submit(task))
+        return True
 
     # ---------------------------------------------------------- actor submit
 

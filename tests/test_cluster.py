@@ -151,3 +151,73 @@ def test_actor_out_of_restarts_dies():
             ray_tpu.get(f.pid.remote(), timeout=60)
     finally:
         ray_tpu.shutdown()
+
+
+# ------------------------------------------------- lineage reconstruction
+
+
+def test_lineage_reconstruction_after_node_death(three_node_cluster):
+    """A plasma-stored task return survives losing its primary copy:
+    the owner resubmits the producing task (reference:
+    src/ray/core_worker/object_recovery_manager.cc)."""
+    import numpy as np
+
+    cluster = three_node_cluster
+    node_b = cluster.nodes[-1]
+
+    @ray_tpu.remote(resources={"nodeB": 0.1}, max_retries=3)
+    def produce():
+        return np.full(500_000, 7.0)  # 4MB -> plasma, primary on node B
+
+    ref = produce.remote()
+    assert float(ray_tpu.get(ref, timeout=60)[0]) == 7.0
+
+    cluster.remove_node(node_b, graceful=False)
+    # the lost primary must be recomputed elsewhere; re-add capacity so
+    # the resubmitted task has somewhere to run
+    cluster.add_node(num_cpus=2, resources={"nodeB": 1})
+    value = ray_tpu.get(ref, timeout=120)
+    assert float(value[0]) == 7.0 and value.shape == (500_000,)
+
+
+def test_lineage_reconstruction_for_borrower(three_node_cluster):
+    """A downstream task consuming a lost object triggers recovery via
+    the owner (borrower reports the dead location)."""
+    import numpy as np
+
+    cluster = three_node_cluster
+    node_b = cluster.nodes[-1]
+
+    @ray_tpu.remote(resources={"nodeB": 0.1}, max_retries=3)
+    def produce():
+        return np.ones(500_000)
+
+    @ray_tpu.remote(resources={"nodeA": 0.1})
+    def consume(arr):
+        return float(arr.sum())
+
+    ref = produce.remote()
+    ray_tpu.wait([ref], num_returns=1, timeout=60)
+    cluster.remove_node(node_b, graceful=False)
+    cluster.add_node(num_cpus=2, resources={"nodeB": 1})
+    assert ray_tpu.get(consume.remote(ref), timeout=120) == 500_000.0
+
+
+def test_put_objects_are_not_reconstructible(three_node_cluster):
+    """ray.put data has no lineage (matching the reference): losing the
+    primary raises ObjectLostError rather than hanging."""
+    import numpy as np
+
+    cluster = three_node_cluster
+    node_b = cluster.nodes[-1]
+
+    @ray_tpu.remote(resources={"nodeB": 0.1})
+    def put_there(arr):
+        import ray_tpu as rt
+
+        return rt.put(arr)  # nested ref owned by the node-B worker
+
+    inner = ray_tpu.get(put_there.remote(np.zeros(500_000)), timeout=60)
+    cluster.remove_node(node_b, graceful=False)
+    with pytest.raises(ray_tpu.RayError):
+        ray_tpu.get(inner, timeout=30)
