@@ -427,16 +427,19 @@ class CoreWorker(RpcHost):
                         raise entry.error
                     if entry.in_plasma:
                         plasma_fetch.append((i, ref, entry.node_addr))
-                    elif entry.raw is None and entry.value is None:
-                        # raced clear_resolution (reconstruction started
-                        # between wait_ready and this read): go around
-                        carry.append((i, ref))
-                        continue
                     else:
-                        if entry.value is None and entry.raw is not None:
+                        # snapshot: clear_resolution may race this read
+                        value, raw = entry.value, entry.raw
+                        if value is None and raw is None:
+                            # raced clear (reconstruction started between
+                            # wait_ready and this read): go around
+                            carry.append((i, ref))
+                            continue
+                        if value is None:
                             with SerializationContext():
-                                entry.value = serialization.deserialize(entry.raw)
-                        out[i] = entry.value
+                                value = serialization.deserialize(raw)
+                                entry.value = value
+                        out[i] = value
                 elif self.rc.is_freed(oid):
                     raise ObjectFreedError(f"object {oid[:16]} was freed by its owner")
                 else:

@@ -10,6 +10,8 @@ into one jitted forward, the TPU-efficient serving shape.
 from ray_tpu.serve.api import (Application, Deployment, DeploymentHandle,
                                batch, delete, deployment, get_handle, run,
                                shutdown)
+from ray_tpu.serve.http import shutdown_http, start_http
 
 __all__ = ["deployment", "run", "get_handle", "delete", "shutdown",
-           "batch", "Deployment", "DeploymentHandle", "Application"]
+           "batch", "Deployment", "DeploymentHandle", "Application",
+           "start_http", "shutdown_http"]

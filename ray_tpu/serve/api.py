@@ -164,12 +164,18 @@ class Deployment:
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     init_args: tuple = ()
     init_kwargs: Dict[str, Any] = field(default_factory=dict)
+    # reference: _private/autoscaling_policy.py — replica count follows
+    # reported ongoing requests: {"min_replicas", "max_replicas",
+    # "target_ongoing_requests"}
+    autoscaling_config: Optional[Dict[str, Any]] = None
 
     def options(self, **opts) -> "Deployment":
         d = Deployment(self.func_or_class, self.name, self.num_replicas,
                        self.max_ongoing_requests,
                        dict(self.ray_actor_options),
-                       self.init_args, dict(self.init_kwargs))
+                       self.init_args, dict(self.init_kwargs),
+                       dict(self.autoscaling_config)
+                       if self.autoscaling_config else None)
         for k, v in opts.items():
             setattr(d, k, v)
         return d
@@ -188,11 +194,13 @@ class Application:
 
 def deployment(_cls: Any = None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_ongoing_requests: int = 8,
-               ray_actor_options: Optional[Dict[str, Any]] = None):
+               ray_actor_options: Optional[Dict[str, Any]] = None,
+               autoscaling_config: Optional[Dict[str, Any]] = None):
     def make(target):
         return Deployment(target, name or getattr(target, "__name__", "app"),
                           num_replicas, max_ongoing_requests,
-                          ray_actor_options or {})
+                          ray_actor_options or {},
+                          autoscaling_config=autoscaling_config)
 
     if _cls is not None:
         return make(_cls)
@@ -221,44 +229,176 @@ class _Replica:
 
 
 class ServeController:
-    """Named actor owning deployment state
-    (reference: _private/controller.py reconciliation)."""
+    """Named actor owning deployment state, with a background
+    reconciliation loop that replaces dead replicas and autoscales on
+    handle-reported load (reference: _private/controller.py,
+    deployment_state.py:1226, autoscaling_policy.py)."""
+
+    RECONCILE_PERIOD_S = 0.5
 
     def __init__(self):
         self.apps: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        self._version_counter = 0  # monotonic across redeploys
+        self._stop = threading.Event()
+        self._loop_thread = threading.Thread(
+            target=self._reconcile_loop, name="serve-reconcile", daemon=True)
+        self._loop_thread.start()
+
+    # ---- desired state -----------------------------------------------------
 
     def deploy(self, name: str, target_blob: bytes, num_replicas: int,
                max_ongoing: int, init_args, init_kwargs,
-               actor_options: Dict[str, Any]):
+               actor_options: Dict[str, Any],
+               autoscaling: Optional[Dict[str, Any]] = None):
         import ray_tpu
 
-        existing = self.apps.get(name)
+        if autoscaling:
+            num_replicas = max(num_replicas,
+                               int(autoscaling.get("min_replicas", 1)))
+        app = {
+            "target_blob": target_blob,
+            "init_args": init_args,
+            "init_kwargs": init_kwargs,
+            "actor_options": actor_options,
+            "max_ongoing": max_ongoing,
+            "autoscaling": autoscaling,
+            "desired": num_replicas,
+            "replicas": [],
+            "version": 0,
+            "ongoing": {},   # handle_id -> (reported count, timestamp)
+        }
+        # blue-green: bring the new replicas up FIRST; a failing redeploy
+        # must not take down a working deployment
+        replicas = [self._start_replica(app) for _ in range(num_replicas)]
+        try:
+            # block until every replica's constructor finished (model loaded)
+            ray_tpu.get([r.health.remote() for r in replicas], timeout=600)
+        except ray_tpu.RayError:
+            for r in replicas:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+            raise
+        app["replicas"] = replicas
+        with self._lock:
+            self._version_counter += 1
+            app["version"] = self._version_counter
+            existing = self.apps.get(name)
+            self.apps[name] = app
         if existing:
             for h in existing["replicas"]:
                 try:
                     ray_tpu.kill(h)
                 except Exception:
                     pass
-        cls = ray_tpu.remote(_Replica).options(
-            max_concurrency=max(2, max_ongoing), **actor_options)
-        replicas = [cls.remote(target_blob, init_args, init_kwargs)
-                    for _ in range(num_replicas)]
-        # block until every replica's constructor finished (model loaded)
-        ray_tpu.get([r.health.remote() for r in replicas], timeout=600)
-        self.apps[name] = {"replicas": replicas,
-                           "max_ongoing": max_ongoing}
         return True
 
-    def get_replicas(self, name: str):
-        app = self.apps.get(name)
-        if app is None:
-            return None
-        return [r._actor_id for r in app["replicas"]]
+    def _start_replica(self, app):
+        import ray_tpu
+
+        cls = ray_tpu.remote(_Replica).options(
+            max_concurrency=max(2, app["max_ongoing"]),
+            **app["actor_options"])
+        return cls.remote(app["target_blob"], app["init_args"],
+                          app["init_kwargs"])
+
+    # ---- reconciliation ----------------------------------------------------
+
+    def _reconcile_loop(self):
+        import ray_tpu
+
+        while not self._stop.wait(self.RECONCILE_PERIOD_S):
+            with self._lock:
+                apps = dict(self.apps)
+            for name, app in apps.items():
+                try:
+                    self._reconcile_one(ray_tpu, name, app)
+                except Exception:
+                    pass  # never let one deployment wedge the loop
+
+    def _reconcile_one(self, ray_tpu, name: str, app: Dict[str, Any]):
+        # 1. health: drop replicas that fail a health probe
+        alive = []
+        changed = False
+        probes = [(r, r.health.remote()) for r in app["replicas"]]
+        for r, probe in probes:
+            try:
+                ray_tpu.get(probe, timeout=5)
+                alive.append(r)
+            except ray_tpu.RayError:
+                changed = True
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+        # 2. autoscaling: follow reported ongoing requests
+        desired = app["desired"]
+        auto = app.get("autoscaling")
+        if auto:
+            now = time.monotonic()
+            with self._lock:
+                reports = list(app["ongoing"].values())
+            total = sum(c for c, ts in reports if now - ts < 5.0)
+            target = max(1, int(auto.get("target_ongoing_requests", 2)))
+            import math
+
+            desired = min(int(auto.get("max_replicas", 8)),
+                          max(int(auto.get("min_replicas", 1)),
+                              math.ceil(total / target)))
+            app["desired"] = desired
+        # 3. converge replica count
+        while len(alive) > desired:
+            victim = alive.pop()
+            changed = True
+            try:
+                ray_tpu.kill(victim)
+            except Exception:
+                pass
+        started = []
+        while len(alive) + len(started) < desired:
+            started.append(self._start_replica(app))
+            changed = True
+        if started:
+            for r in started:
+                try:
+                    ray_tpu.get(r.health.remote(), timeout=600)
+                    alive.append(r)
+                except ray_tpu.RayError:
+                    pass
+        if changed:
+            with self._lock:
+                if self.apps.get(name) is app:
+                    app["replicas"] = alive
+                    self._version_counter += 1
+                    app["version"] = self._version_counter
+
+    # ---- handle-facing RPCs ------------------------------------------------
+
+    def get_replicas(self, name: str, known_version: int = -1):
+        with self._lock:
+            app = self.apps.get(name)
+            if app is None:
+                return None
+            if known_version == app["version"]:
+                return {"version": app["version"], "unchanged": True}
+            return {"version": app["version"],
+                    "replica_ids": [r._actor_id for r in app["replicas"]],
+                    "max_ongoing": app["max_ongoing"]}
+
+    def report_metrics(self, name: str, handle_id: str, ongoing: int):
+        with self._lock:
+            app = self.apps.get(name)
+            if app is not None:
+                app["ongoing"][handle_id] = (ongoing, time.monotonic())
+        return True
 
     def delete(self, name: str):
         import ray_tpu
 
-        app = self.apps.pop(name, None)
+        with self._lock:
+            app = self.apps.pop(name, None)
         if app:
             for h in app["replicas"]:
                 try:
@@ -268,38 +408,153 @@ class ServeController:
         return True
 
     def list_deployments(self):
-        return {name: len(app["replicas"]) for name, app in self.apps.items()}
+        with self._lock:
+            return {name: len(app["replicas"])
+                    for name, app in self.apps.items()}
 
 
 # ------------------------------------------------------------------ handle
 
 
+class _SharedWaiter:
+    """One background thread per process that watches in-flight serve
+    refs and fires completion callbacks — replaces the former
+    thread-per-request watcher."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items: Dict[str, Callable[[], None]] = {}  # oid -> cb
+        self._refs: Dict[str, Any] = {}
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def watch(self, ref, cb: Callable[[], None]) -> None:
+        with self._lock:
+            self._items[ref.oid] = cb
+            self._refs[ref.oid] = ref
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="serve-waiter", daemon=True)
+                self._thread.start()
+        self._wake.set()
+
+    def _run(self):
+        import ray_tpu
+
+        idle_rounds = 0
+        err_rounds = 0
+        while True:
+            with self._lock:
+                refs = list(self._refs.values())
+                if not refs and idle_rounds >= 100:
+                    # retire under the lock so a concurrent watch() either
+                    # sees a dead thread (and restarts one) or we see its ref
+                    self._thread = None
+                    return
+            if not refs:
+                self._wake.wait(0.1)
+                self._wake.clear()
+                idle_rounds += 1
+                continue
+            idle_rounds = 0
+            try:
+                ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.2)
+                err_rounds = 0
+            except Exception:
+                # transient runtime trouble must not fire callbacks for
+                # still-running requests; drain only if it persists
+                # (runtime torn down)
+                err_rounds += 1
+                if err_rounds < 50:
+                    time.sleep(0.1)
+                    continue
+                ready = refs
+            for r in ready:
+                with self._lock:
+                    cb = self._items.pop(r.oid, None)
+                    self._refs.pop(r.oid, None)
+                if cb is not None:
+                    try:
+                        cb()
+                    except Exception:
+                        pass
+
+
+_shared_waiter = _SharedWaiter()
+
+
 class DeploymentHandle:
     """Client-side router: least-outstanding-requests replica choice
-    (reference: router.py assign_request + pow_2_scheduler.py)."""
+    (reference: router.py assign_request + pow_2_scheduler.py), with
+    periodic replica-list refresh from the controller and load reporting
+    for autoscaling."""
 
-    def __init__(self, name: str, replica_ids: List[str]):
+    REFRESH_PERIOD_S = 1.0
+
+    def __init__(self, name: str, replica_ids: List[str], version: int = 0):
+        import uuid
+
         self._name = name
+        self._handle_id = uuid.uuid4().hex[:12]
+        self._lock = threading.Lock()
+        self._version = version
+        self._set_replicas(replica_ids)
+        self._last_refresh = time.monotonic()
+
+    def _set_replicas(self, replica_ids: List[str]):
         from ray_tpu.api import ActorHandle
 
         self._replicas = [ActorHandle(rid) for rid in replica_ids]
-        self._inflight = [0] * len(self._replicas)
-        self._lock = threading.Lock()
+        # inflight is keyed by actor id so counts survive replica-list
+        # swaps: late completion callbacks decrement the right counter
+        # instead of corrupting a rebuilt positional array
+        old = getattr(self, "_inflight", {})
+        self._inflight = {rid: old.get(rid, 0) for rid in replica_ids}
 
-    def remote(self, *args, _method: str = "__call__", **kwargs):
+    def _maybe_refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_refresh < self.REFRESH_PERIOD_S:
+            return
         import ray_tpu
 
-        with self._lock:
-            idx = min(range(len(self._replicas)),
-                      key=lambda i: self._inflight[i])
-            self._inflight[idx] += 1
-        ref = self._replicas[idx].handle_request.remote(_method, args, kwargs)
-
-        def _done_cb():
+        self._last_refresh = now
+        try:
+            ctrl = _controller()
             with self._lock:
-                self._inflight[idx] -= 1
+                ongoing = sum(self._inflight.values())
+            ctrl.report_metrics.remote(self._name, self._handle_id, ongoing)
+            info = ray_tpu.get(
+                ctrl.get_replicas.remote(self._name, self._version),
+                timeout=30)
+        except ray_tpu.RayError:
+            return
+        if info is None or info.get("unchanged"):
+            return
+        if info["version"] != self._version:
+            with self._lock:
+                self._version = info["version"]
+                self._set_replicas(info["replica_ids"])
 
-        _watch_ref(ref, _done_cb)
+    def remote(self, *args, _method: str = "__call__", **kwargs):
+        self._maybe_refresh()
+        if not self._replicas:
+            self._maybe_refresh(force=True)
+        with self._lock:
+            if not self._replicas:
+                raise RuntimeError(
+                    f"deployment {self._name!r} has no replicas")
+            replica = min(self._replicas,
+                          key=lambda r: self._inflight.get(r._actor_id, 0))
+            rid = replica._actor_id
+            self._inflight[rid] = self._inflight.get(rid, 0) + 1
+        ref = replica.handle_request.remote(_method, args, kwargs)
+
+        def _done_cb(rid=rid):
+            with self._lock:
+                if rid in self._inflight:
+                    self._inflight[rid] -= 1
+
+        _shared_waiter.watch(ref, _done_cb)
         return ref
 
     def method(self, name: str):
@@ -307,19 +562,6 @@ class DeploymentHandle:
             return self.remote(*args, _method=name, **kwargs)
 
         return call
-
-
-def _watch_ref(ref, cb):
-    def watcher():
-        import ray_tpu
-
-        try:
-            ray_tpu.wait([ref], num_returns=1, timeout=600)
-        except Exception:
-            pass
-        cb()
-
-    threading.Thread(target=watcher, daemon=True).start()
 
 
 # ---------------------------------------------------------------- serve API
@@ -353,7 +595,7 @@ def run(app: Application, name: Optional[str] = None) -> DeploymentHandle:
     ray_tpu.get(ctrl.deploy.remote(
         dep_name, cloudpickle.dumps(d.func_or_class), d.num_replicas,
         d.max_ongoing_requests, d.init_args, d.init_kwargs,
-        d.ray_actor_options), timeout=600)
+        d.ray_actor_options, d.autoscaling_config), timeout=600)
     return get_handle(dep_name)
 
 
@@ -361,10 +603,10 @@ def get_handle(name: str) -> DeploymentHandle:
     import ray_tpu
 
     ctrl = _controller()
-    replica_ids = ray_tpu.get(ctrl.get_replicas.remote(name), timeout=60)
-    if replica_ids is None:
+    info = ray_tpu.get(ctrl.get_replicas.remote(name), timeout=60)
+    if info is None:
         raise ValueError(f"no deployment named {name!r}")
-    return DeploymentHandle(name, replica_ids)
+    return DeploymentHandle(name, info["replica_ids"], info["version"])
 
 
 def delete(name: str):

@@ -1,0 +1,195 @@
+"""HTTP ingress for ray_tpu.serve.
+
+Equivalent of the reference's per-node proxy actors
+(reference: python/ray/serve/_private/proxy.py — uvicorn HTTP ingress
+routing to DeploymentHandles via the router).  This proxy is an actor
+hosting a minimal asyncio HTTP/1.1 server (no third-party deps in the
+image): requests to ``/<deployment>`` are routed through a
+DeploymentHandle, so they get the same least-outstanding-requests
+balancing, replica refresh, and autoscaling metrics as in-cluster
+callers.
+
+Routing convention:
+  GET  /<name>            -> callable invoked with the query dict ({} if none)
+  POST /<name>  (json)    -> callable invoked with the parsed JSON body
+  POST /<name>  (other)   -> callable invoked with the raw body bytes
+  GET  /-/healthz         -> 200 "ok" (proxy liveness)
+Responses are JSON-encoded when possible, else ``str()``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qsl, urlsplit
+
+PROXY_NAME = "_serve_http_proxy"
+
+
+class _HttpProxy:
+    """Actor wrapping the asyncio HTTP server (one per ingress port)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        import asyncio
+
+        self._handles: Dict[str, Any] = {}
+        self._loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._addr: Optional[tuple] = None
+        self._thread = threading.Thread(
+            target=self._serve_forever, args=(host, port),
+            name="serve-http", daemon=True)
+        self._thread.start()
+        self._started.wait(30)
+
+    def _serve_forever(self, host: str, port: int):
+        import asyncio
+
+        asyncio.set_event_loop(self._loop)
+
+        async def _start():
+            server = await asyncio.start_server(self._client, host, port)
+            self._addr = server.sockets[0].getsockname()[:2]
+            self._started.set()
+            return server
+
+        server = self._loop.run_until_complete(_start())
+        try:
+            self._loop.run_forever()
+        finally:
+            server.close()
+
+    def address(self):
+        return list(self._addr) if self._addr else None
+
+    def health(self):
+        return True
+
+    # ---- request handling --------------------------------------------------
+
+    async def _client(self, reader, writer):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+                try:
+                    method, target, _ = line.decode("latin1").split(" ", 2)
+                except ValueError:
+                    break
+                headers: Dict[str, str] = {}
+                while True:
+                    h = await reader.readline()
+                    if not h or h in (b"\r\n", b"\n"):
+                        break
+                    k, _, v = h.decode("latin1").partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                body = b""
+                length = int(headers.get("content-length", 0) or 0)
+                if length:
+                    body = await reader.readexactly(length)
+                status, payload = await self._route(method, target,
+                                                    headers, body)
+                keep = headers.get("connection", "keep-alive") != "close"
+                writer.write(
+                    b"HTTP/1.1 " + status.encode() + b"\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"Content-Length: " + str(len(payload)).encode() + b"\r\n"
+                    b"Connection: " + (b"keep-alive" if keep else b"close") +
+                    b"\r\n\r\n" + payload)
+                await writer.drain()
+                if not keep:
+                    break
+        except (ConnectionError, TimeoutError, Exception):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _route(self, method: str, target: str, headers, body: bytes):
+        import asyncio
+
+        parts = urlsplit(target)
+        path = parts.path.strip("/")
+        if path == "-/healthz":
+            return "200 OK", b'"ok"'
+        if not path or "/" in path:
+            return "404 Not Found", json.dumps(
+                {"error": f"no route {parts.path!r}"}).encode()
+        if method == "GET":
+            arg: Any = dict(parse_qsl(parts.query))
+        elif headers.get("content-type", "").startswith("application/json"):
+            try:
+                arg = json.loads(body or b"null")
+            except ValueError:
+                return "400 Bad Request", b'{"error": "invalid json"}'
+        else:
+            arg = body
+        loop = asyncio.get_running_loop()
+        try:
+            result = await loop.run_in_executor(
+                None, self._call_blocking, path, arg)
+        except KeyError:
+            return "404 Not Found", json.dumps(
+                {"error": f"no deployment named {path!r}"}).encode()
+        except Exception as e:
+            return "500 Internal Server Error", json.dumps(
+                {"error": f"{type(e).__name__}: {e}"}).encode()
+        try:
+            payload = json.dumps(result).encode()
+        except TypeError:
+            payload = json.dumps(str(result)).encode()
+        return "200 OK", payload
+
+    def _call_blocking(self, name: str, arg: Any):
+        import ray_tpu
+        from ray_tpu.serve import api as serve_api
+
+        handle = self._handles.get(name)
+        if handle is None:
+            try:
+                handle = serve_api.get_handle(name)
+            except ValueError:
+                raise KeyError(name)
+            self._handles[name] = handle
+        try:
+            return ray_tpu.get(handle.remote(arg), timeout=120)
+        except ray_tpu.RayError:
+            # replicas may have been replaced wholesale: refresh once
+            self._handles.pop(name, None)
+            handle = serve_api.get_handle(name)
+            self._handles[name] = handle
+            return ray_tpu.get(handle.remote(arg), timeout=120)
+
+
+def start_http(host: str = "127.0.0.1", port: int = 0):
+    """Start (or fetch) the HTTP ingress; returns (host, port)."""
+    import ray_tpu
+    import ray_tpu.api as rapi
+
+    try:
+        proxy = ray_tpu.get_actor(PROXY_NAME)
+    except ValueError:
+        try:
+            proxy = rapi.ActorClass(
+                _HttpProxy, name=PROXY_NAME, lifetime="detached",
+                max_concurrency=16).remote(host, port)
+        except ray_tpu.RayError:
+            proxy = ray_tpu.get_actor(PROXY_NAME)
+    addr = ray_tpu.get(proxy.address.remote(), timeout=60)
+    if addr is None:
+        raise RuntimeError("HTTP proxy failed to bind")
+    return addr[0], addr[1]
+
+
+def shutdown_http():
+    import ray_tpu
+
+    try:
+        proxy = ray_tpu.get_actor(PROXY_NAME)
+    except ValueError:
+        return
+    ray_tpu.kill(proxy)

@@ -114,3 +114,116 @@ def test_get_handle_and_delete(cluster):
     serve.delete("tmp")
     with pytest.raises(ValueError):
         serve.get_handle("tmp")
+
+
+# -------------------------------------------- ingress / recovery / scaling
+
+
+def test_http_ingress(cluster):
+    """curl-level e2e through the asyncio proxy
+    (reference: serve/_private/proxy.py)."""
+    import requests
+
+    @serve.deployment(name="doubler")
+    def doubler(x):
+        return {"doubled": int(x["n"]) * 2} if isinstance(x, dict) else x * 2
+
+    serve.run(doubler.bind())
+    host, port = serve.start_http()
+    base = f"http://{host}:{port}"
+    assert requests.get(f"{base}/-/healthz", timeout=30).status_code == 200
+    r = requests.post(f"{base}/doubler", json={"n": 21}, timeout=60)
+    assert r.status_code == 200 and r.json() == {"doubled": 42}
+    r = requests.get(f"{base}/doubler?n=5", timeout=60)
+    assert r.json()["doubled"] == 10
+    assert requests.post(f"{base}/nosuch", json=1, timeout=30).status_code == 404
+    serve.delete("doubler")
+    assert requests.post(f"{base}/doubler", json=1, timeout=30).status_code in (404, 500)
+    serve.shutdown_http()
+
+
+def test_replica_death_recovery(cluster):
+    """The controller's reconcile loop replaces a killed replica
+    (reference: deployment_state.py replica FSM recovery)."""
+    @serve.deployment(name="sturdy", num_replicas=2)
+    def f(x):
+        return x
+
+    handle = serve.run(f.bind())
+    assert ray_tpu.get(handle.remote(1), timeout=60) == 1
+    # kill one replica out from under the controller
+    ray_tpu.kill(handle._replicas[0])
+    from ray_tpu.serve.api import CONTROLLER_NAME
+
+    ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+    deadline = time.time() + 30
+    ok = False
+    while time.time() < deadline:
+        if ray_tpu.get(ctrl.list_deployments.remote(), timeout=30).get("sturdy") == 2:
+            ok = True
+            break
+        time.sleep(0.5)
+    assert ok, "replica was not replaced"
+    # requests still succeed after recovery (handle refreshes replicas)
+    time.sleep(1.1)  # let the handle's refresh window lapse
+    out = ray_tpu.get([handle.remote(i) for i in range(6)], timeout=60)
+    assert out == list(range(6))
+    serve.delete("sturdy")
+
+
+def test_autoscaling_scales_up_under_load(cluster):
+    """Replica count follows reported ongoing requests
+    (reference: autoscaling_policy.py)."""
+    @serve.deployment(name="slow", autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3, "target_ongoing_requests": 1})
+    def slow(x):
+        time.sleep(0.4)
+        return x
+
+    handle = serve.run(slow.bind())
+    from ray_tpu.serve.api import CONTROLLER_NAME
+    ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+    assert ray_tpu.get(ctrl.list_deployments.remote(), timeout=30)["slow"] == 1
+    deadline = time.time() + 45
+    scaled = False
+    pending = []
+    while time.time() < deadline and not scaled:
+        if pending:
+            _, pending = ray_tpu.wait(pending, num_returns=len(pending),
+                                      timeout=0.01)
+            pending = list(pending)
+        while len(pending) < 6:
+            pending.append(handle.remote(0))
+        time.sleep(0.4)
+        if ray_tpu.get(ctrl.list_deployments.remote(), timeout=30)["slow"] >= 2:
+            scaled = True
+    assert scaled, "deployment did not scale up under load"
+    ray_tpu.get(pending, timeout=120)
+    serve.delete("slow")
+
+
+def test_handle_survives_redeploy(cluster):
+    """An existing handle refreshes to the new replica set after a
+    redeploy (version is monotonic across deploys)."""
+    @serve.deployment(name="redep")
+    def f(x):
+        return x + 1
+
+    handle = serve.run(f.bind())
+    assert ray_tpu.get(handle.remote(1), timeout=60) == 2
+
+    @serve.deployment(name="redep")
+    def f2(x):
+        return x + 100
+
+    serve.run(f2.bind(), name="redep")
+    time.sleep(1.1)  # old handle's refresh window lapses
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            if ray_tpu.get(handle.remote(1), timeout=30) == 101:
+                break
+        except ray_tpu.RayError:
+            time.sleep(0.2)  # may race the old-replica teardown
+    assert ray_tpu.get(handle.remote(1), timeout=30) == 101
+    serve.delete("redep")
