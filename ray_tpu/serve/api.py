@@ -348,31 +348,58 @@ class ServeController:
                           max(int(auto.get("min_replicas", 1)),
                               math.ceil(total / target)))
             app["desired"] = desired
-        # 3. converge replica count
+        # 3. converge replica count; scale-down victims drain first (they
+        # leave the routing table now, die a few seconds later so
+        # in-flight requests finish)
+        now = time.monotonic()
         while len(alive) > desired:
             victim = alive.pop()
             changed = True
-            try:
-                ray_tpu.kill(victim)
-            except Exception:
-                pass
+            app.setdefault("draining", []).append((victim, now + 5.0))
+        still_draining = []
+        for victim, kill_at in app.get("draining", []):
+            if now >= kill_at:
+                try:
+                    ray_tpu.kill(victim)
+                except Exception:
+                    pass
+            else:
+                still_draining.append((victim, kill_at))
+        app["draining"] = still_draining
         started = []
         while len(alive) + len(started) < desired:
             started.append(self._start_replica(app))
             changed = True
-        if started:
-            for r in started:
+        for r in started:
+            try:
+                # bounded so one stuck constructor can't freeze recovery
+                # for every other deployment; retried next round if slow
+                ray_tpu.get(r.health.remote(), timeout=30)
+                alive.append(r)
+            except ray_tpu.RayError:
                 try:
-                    ray_tpu.get(r.health.remote(), timeout=600)
-                    alive.append(r)
-                except ray_tpu.RayError:
+                    ray_tpu.kill(r)
+                except Exception:
                     pass
+        # prune stale handle reports so 'ongoing' doesn't grow unboundedly
+        with self._lock:
+            app["ongoing"] = {h: (c, ts) for h, (c, ts) in
+                              app["ongoing"].items() if now - ts < 10.0}
         if changed:
             with self._lock:
-                if self.apps.get(name) is app:
+                current = self.apps.get(name) is app
+                if current:
                     app["replicas"] = alive
                     self._version_counter += 1
                     app["version"] = self._version_counter
+            if not current:
+                # app was redeployed/deleted mid-round: replicas started
+                # this round would otherwise leak
+                for r in started:
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:
+                        pass
 
     # ---- handle-facing RPCs ------------------------------------------------
 
@@ -400,7 +427,9 @@ class ServeController:
         with self._lock:
             app = self.apps.pop(name, None)
         if app:
-            for h in app["replicas"]:
+            victims = list(app["replicas"]) + [
+                v for v, _ in app.get("draining", [])]
+            for h in victims:
                 try:
                     ray_tpu.kill(h)
                 except Exception:

@@ -101,8 +101,15 @@ class _HttpProxy:
                 await writer.drain()
                 if not keep:
                     break
-        except (ConnectionError, TimeoutError, Exception):
-            pass
+        except (ConnectionError, TimeoutError) as e:
+            pass  # peer went away: normal
+        except Exception as e:
+            import asyncio
+            import sys
+
+            if not isinstance(e, asyncio.IncompleteReadError):
+                print(f"[serve.http] connection handler error: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
         finally:
             try:
                 writer.close()

@@ -74,29 +74,53 @@ class JaxTrainer:
         self.datasets = datasets or {}
 
     def fit(self) -> Result:
-        import os
+        """Run to completion, rebuilding the worker gang and resuming from
+        the last reported checkpoint on failure, up to
+        RunConfig.failure_max_retries times (reference:
+        backend_executor.get_with_failure_handling :629 +
+        tune_controller._schedule_trial_restore :1792 — Train is gang-
+        restart, not elastic)."""
+        resume = self.resume_from_checkpoint
+        history: List[Dict[str, Any]] = []
+        failures = 0
+        while True:
+            group = WorkerGroup(self.scaling.num_workers,
+                                self.scaling.worker_resources())
+            try:
+                return self._fit(group, resume, history)
+            except TrainingFailedError as e:
+                ckpt = getattr(e, "last_checkpoint", None)
+                if ckpt:
+                    resume = ckpt
+                if failures >= self.run_config.failure_max_retries:
+                    raise
+                failures += 1
+            finally:
+                group.shutdown()
 
-        n = self.scaling.num_workers
-        group = WorkerGroup(n, self.scaling.worker_resources())
-        try:
-            return self._fit(group)
-        finally:
-            group.shutdown()
-
-    def _fit(self, group: WorkerGroup) -> Result:
+    def _fit(self, group: WorkerGroup, resume: Optional[str] = None,
+             history: Optional[List[Dict[str, Any]]] = None) -> Result:
         import os
 
         n = group.num_workers
         trial_dir = os.path.join(self.run_config.storage_path,
                                  f"{self.run_config.name}-{int(time.time())}")
         os.makedirs(trial_dir, exist_ok=True)
+        import ray_tpu
+
         # multi-process rendezvous (reference: backend_executor start —
         # rank 0 address/port shared with the gang before the loop starts)
         if n > 1:
-            info0 = group.execute_single(0, "node_info")
-            port = group.execute_single(0, "free_port")
-            coordinator = f"{info0['ip']}:{port}"
-            self._init_distributed(group, coordinator, n)
+            try:
+                info0 = group.execute_single(0, "node_info")
+                port = group.execute_single(0, "free_port")
+                coordinator = f"{info0['ip']}:{port}"
+                self._init_distributed(group, coordinator, n)
+            except ray_tpu.RayError as e:
+                err = TrainingFailedError(
+                    f"worker gang failed during rendezvous: {e}")
+                err.last_checkpoint = resume
+                raise err from e
         fn_blob = cloudpickle.dumps(self.train_loop)
         # dataset ingest: each worker gets its round-robin block shard
         # (reference: _internal/data_config.py streaming_split)
@@ -109,11 +133,16 @@ class JaxTrainer:
         refs = []
         for rank, w in enumerate(group.workers):
             refs.append(w.run_async.remote(
-                fn_blob, self.config, checkpoint=self.resume_from_checkpoint,
+                fn_blob, self.config, checkpoint=resume,
                 experiment_name=self.run_config.name, trial_dir=trial_dir,
                 datasets=shard_map[rank] or None))
-        ray_tpu.get(refs, timeout=120.0)
-        return self._poll_until_done(group, trial_dir)
+        try:
+            ray_tpu.get(refs, timeout=120.0)
+        except ray_tpu.RayError as e:
+            err = TrainingFailedError(f"worker gang failed to launch: {e}")
+            err.last_checkpoint = resume
+            raise err from e
+        return self._poll_until_done(group, trial_dir, history)
 
     def _init_distributed(self, group: WorkerGroup, coordinator: str, n: int):
         import ray_tpu
@@ -122,20 +151,26 @@ class JaxTrainer:
                 for rank, w in enumerate(group.workers)]
         ray_tpu.get(refs, timeout=300.0)
 
-    def _poll_until_done(self, group: WorkerGroup, trial_dir: str) -> Result:
+    def _poll_until_done(self, group: WorkerGroup, trial_dir: str,
+                         history: Optional[List[Dict[str, Any]]] = None) -> Result:
         import ray_tpu
 
-        history: List[Dict[str, Any]] = []
+        history = history if history is not None else []
         last_checkpoint: Optional[str] = None
         done = [False] * group.num_workers
         finals: List[Any] = [None] * group.num_workers
+
+        def _fail(msg: str, cause: BaseException):
+            err = TrainingFailedError(msg)
+            err.last_checkpoint = last_checkpoint  # resume point for fit()
+            raise err from cause
+
         while not all(done):
             time.sleep(0.05)
             try:
                 polls = group.execute("poll", timeout=120.0)
             except (ray_tpu.ActorDiedError, ray_tpu.RayError) as e:
-                raise TrainingFailedError(
-                    f"a training worker died mid-run: {e}") from e
+                _fail(f"a training worker died mid-run: {e}", e)
             for rank, p in enumerate(polls):
                 for rep in p["reports"]:
                     if rank == 0 and "_error" not in rep["metrics"]:
@@ -146,8 +181,7 @@ class JaxTrainer:
                     done[rank] = True
                     if p["error"] is not None:
                         err = cloudpickle.loads(p["error"])
-                        raise TrainingFailedError(
-                            f"train loop failed on rank {rank}: {err}") from err
+                        _fail(f"train loop failed on rank {rank}: {err}", err)
                     finals[rank] = p["final"]
         return Result(metrics=history[-1] if history else {},
                       metrics_history=history,

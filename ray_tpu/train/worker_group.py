@@ -18,6 +18,19 @@ from typing import Any, Callable, Dict, List, Optional
 import cloudpickle
 
 
+def _node_ip() -> str:
+    """This host's outbound IP (reference: ray._private.services
+    get_node_ip_address — UDP-connect trick, no packets sent)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
 class TrainWorker:
     """Actor hosting one rank of the SPMD gang. The user's train loop
     runs in a thread so poll() stays responsive (actor methods execute
@@ -33,7 +46,7 @@ class TrainWorker:
 
     def node_info(self) -> Dict[str, Any]:
         return {"hostname": socket.gethostname(), "pid": os.getpid(),
-                "ip": "127.0.0.1"}
+                "ip": _node_ip()}
 
     def free_port(self) -> int:
         s = socket.socket()

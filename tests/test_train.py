@@ -190,3 +190,98 @@ def test_report_outside_session_raises():
 
     with pytest.raises(RuntimeError):
         report({"x": 1})
+
+
+# ------------------------------------------------------- fault tolerance
+
+
+def test_fit_retries_worker_death_and_resumes(cluster):
+    """Worker death mid-fit rebuilds the gang and resumes from the last
+    reported checkpoint (reference: backend_executor.py:629 +
+    tune_controller.py:1792 gang-restart semantics)."""
+    import json
+    import os
+    import tempfile
+
+    from ray_tpu import train
+
+    marker = os.path.join(tempfile.mkdtemp(), "died_once")
+
+    def loop(config):
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt:
+            with open(os.path.join(ckpt, "state.json")) as f:
+                start = json.load(f)["step"] + 1
+        for step in range(start, 6):
+            if step == 3 and not os.path.exists(config["marker"]):
+                open(config["marker"], "w").close()
+                os._exit(1)  # hard worker death, not a python error
+            d = os.path.join(train.get_context().trial_dir,
+                             f"ckpt_{step}")
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "state.json"), "w") as f:
+                json.dump({"step": step}, f)
+            train.report({"step": step}, checkpoint=d)
+        return "done"
+
+    trainer = train.JaxTrainer(
+        loop,
+        scaling_config=train.ScalingConfig(num_workers=1),
+        run_config=train.RunConfig(name="ft_run", failure_max_retries=1),
+        train_loop_config={"marker": marker})
+    result = trainer.fit()
+    steps = [m["step"] for m in result.metrics_history]
+    assert result.per_worker_final == ["done"]
+    # ran 0,1,2 then died at 3; resumed at 3 (from ckpt_2) through 5
+    assert steps == [0, 1, 2, 3, 4, 5], steps
+
+
+def test_fit_exhausted_retries_raises(cluster):
+    import os
+
+    from ray_tpu import train
+
+    def loop():
+        os._exit(1)
+
+    trainer = train.JaxTrainer(
+        loop, scaling_config=train.ScalingConfig(num_workers=1),
+        run_config=train.RunConfig(failure_max_retries=1))
+    with pytest.raises(train.TrainingFailedError):
+        trainer.fit()
+
+
+def test_orbax_checkpoint_roundtrip(tmp_path):
+    jax = force_cpu_jax()
+    import jax.numpy as jnp
+
+    from ray_tpu.train import restore_checkpoint, save_checkpoint
+
+    state = {"params": {"w": jnp.arange(6.0).reshape(2, 3)},
+             "step": jnp.int32(7)}
+    path = save_checkpoint(str(tmp_path / "ck"), state)
+    restored = restore_checkpoint(path)
+    assert float(restored["params"]["w"][1][2]) == 5.0
+    assert int(restored["step"]) == 7
+    # restore with a target tree (dtype/sharding-aware path)
+    target = {"params": {"w": jnp.zeros((2, 3))}, "step": jnp.int32(0)}
+    restored2 = restore_checkpoint(path, target=target)
+    assert float(restored2["params"]["w"][0][1]) == 1.0
+
+
+def test_checkpoint_manager_topk(tmp_path):
+    force_cpu_jax()
+    import jax.numpy as jnp
+
+    from ray_tpu.train import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "mgr"), num_to_keep=2,
+                            metric="loss", mode="min")
+    p1 = mgr.save({"x": jnp.float32(1)}, {"loss": 3.0})
+    p2 = mgr.save({"x": jnp.float32(2)}, {"loss": 1.0})
+    p3 = mgr.save({"x": jnp.float32(3)}, {"loss": 2.0})
+    import os
+    assert not os.path.exists(p1)  # worst evicted
+    assert mgr.best_checkpoint() == p2
+    assert mgr.latest_checkpoint() == p3
