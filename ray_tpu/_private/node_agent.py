@@ -174,7 +174,8 @@ class NodeAgent(RpcHost):
             try:
                 reply = await self._head.call(
                     "heartbeat", node_id=self.node_id,
-                    available=self.resources.available.to_dict())
+                    available=self.resources.available.to_dict(),
+                    pending=self.local.pending_demands())
                 self._apply_cluster_view(reply.get("cluster"), reply.get("version"))
             except Exception:
                 pass

@@ -49,6 +49,12 @@ class LocalScheduler:
                 return True, self.drain()
         return False, []
 
+    def pending_demands(self) -> List[Dict[str, float]]:
+        """Resource shapes of queued (unsatisfiable-right-now) requests —
+        the per-node half of the autoscaler's demand signal
+        (reference: load_metrics.py resource_load_by_shape)."""
+        return [d.to_dict() for _, d in self._queue]
+
     def cancel_all(self) -> List[object]:
         """Drop every queued request; returns their tokens (the caller
         wakes the waiters, who observe the queue's backing pool is gone)."""
