@@ -550,7 +550,7 @@ class HeadService(RpcHost):
         actor.wake()
 
     def _node_client(self, node: _NodeEntry) -> RpcClient:
-        if node.client is None or not node.client.connected:
+        if node.client is None or node.client.dead:
             node.client = RpcClient(node.host, node.port, label=f"agent-{node.node_id[:8]}")
         return node.client
 

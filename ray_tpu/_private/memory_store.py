@@ -13,12 +13,12 @@ calls `set_*` — coordination is a per-entry threading.Event.
 from __future__ import annotations
 
 import threading
-import time
-from typing import Any, Dict, List, Optional, Set, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 
 class _Entry:
-    __slots__ = ("event", "value", "raw", "error", "in_plasma", "node_addr")
+    __slots__ = ("event", "value", "raw", "error", "in_plasma", "node_addr",
+                 "waiters")
 
     def __init__(self):
         self.event = threading.Event()
@@ -27,12 +27,14 @@ class _Entry:
         self.error: Optional[BaseException] = None
         self.in_plasma = False
         self.node_addr: Optional[Tuple[str, int]] = None
+        self.waiters: Dict[int, Any] = {}  # token -> callback
 
 
 class MemoryStore:
     def __init__(self):
         self._lock = threading.Lock()
         self._entries: Dict[str, _Entry] = {}
+        self._waiter_tokens = 0
 
     def _entry(self, oid: str) -> _Entry:
         with self._lock:
@@ -40,6 +42,17 @@ class MemoryStore:
             if e is None:
                 e = self._entries[oid] = _Entry()
             return e
+
+    def _fire(self, e: _Entry) -> None:
+        e.event.set()
+        with self._lock:
+            waiters = list(e.waiters.values())
+            e.waiters.clear()
+        for cb in waiters:
+            try:
+                cb()
+            except Exception:
+                pass
 
     # ---- producer side -----------------------------------------------------
 
@@ -50,24 +63,24 @@ class MemoryStore:
     def set_value(self, oid: str, value: Any) -> None:
         e = self._entry(oid)
         e.value = value
-        e.event.set()
+        self._fire(e)
 
     def set_raw(self, oid: str, raw: bytes) -> None:
         """Store serialized inline bytes; deserialized lazily on first get."""
         e = self._entry(oid)
         e.raw = raw
-        e.event.set()
+        self._fire(e)
 
     def set_error(self, oid: str, error: BaseException) -> None:
         e = self._entry(oid)
         e.error = error
-        e.event.set()
+        self._fire(e)
 
     def set_in_plasma(self, oid: str, node_addr: Tuple[str, int]) -> None:
         e = self._entry(oid)
         e.in_plasma = True
         e.node_addr = node_addr
-        e.event.set()
+        self._fire(e)
 
     def reset(self, oid: str) -> None:
         """Forget a resolution (used when re-executing a task for recovery)."""
@@ -115,27 +128,28 @@ class MemoryStore:
             return None
         return e
 
-    def wait_any(self, oids: List[str], num_ready: int,
-                 timeout: Optional[float]) -> Set[str]:
-        """Poll-free wait for `num_ready` of `oids` (for ray.wait).
+    def add_waiter(self, oid: str, callback) -> Optional[int]:
+        """Register a callback fired (once) when the entry resolves.
 
-        Uses a shared condition signaled piggyback on entry events via
-        polling at a short interval — entries are also settable from the
-        IO thread, so a simple bounded poll keeps this correct and simple.
+        Returns None and does NOT register if the entry is already ready
+        (caller should count it immediately); otherwise returns a token
+        for remove_waiter.  Callbacks run on the resolving thread (the
+        RPC IO thread) and must not block.
         """
-        deadline = None if timeout is None else time.monotonic() + timeout
-        ready: Set[str] = set()
-        while True:
-            for oid in oids:
-                if oid not in ready and self.ready(oid):
-                    ready.add(oid)
-            if len(ready) >= num_ready:
-                return ready
-            if deadline is not None and time.monotonic() >= deadline:
-                return ready
-            remaining = 0.01 if deadline is None else min(
-                0.01, max(0.0, deadline - time.monotonic()))
-            time.sleep(remaining)
+        e = self._entry(oid)
+        with self._lock:
+            if e.event.is_set():
+                return None
+            self._waiter_tokens += 1
+            token = self._waiter_tokens
+            e.waiters[token] = callback
+            return token
+
+    def remove_waiter(self, oid: str, token: int) -> None:
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is not None:
+                e.waiters.pop(token, None)
 
     def evict(self, oid: str) -> None:
         with self._lock:

@@ -298,7 +298,7 @@ class NodeAgent(RpcHost):
     def _peer(self, addr: Tuple[str, int]) -> RpcClient:
         addr = (addr[0], addr[1])
         client = self._peers.get(addr)
-        if client is None or not client.connected:
+        if client is None or client.dead:
             client = RpcClient(addr[0], addr[1], label=f"peer-{addr[1]}")
             self._peers[addr] = client
         return client

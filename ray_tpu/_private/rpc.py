@@ -195,6 +195,12 @@ class RpcClient:
         self._req_ids = itertools.count(1)
         self._read_task = None
         self._lock = asyncio.Lock()
+        # True once the connection is unusable (read loop exited or
+        # close() called).  Client caches must key replacement on THIS,
+        # not on `connected`: a freshly created client is not yet
+        # connected, and replacing it mid-connect orphans its read task
+        # (GC'd while pending -> "Task was destroyed" spew + fd leak).
+        self.dead = False
 
     @property
     def connected(self) -> bool:
@@ -210,15 +216,24 @@ class RpcClient:
                 asyncio.open_connection(self.host, self.port),
                 timeout=config.rpc_connect_timeout_s,
             )
+            self.dead = False  # a successful reconnect resurrects
             self._read_task = asyncio.ensure_future(self._read_loop())
 
     async def close(self) -> None:
+        self.dead = True
         if self._writer is not None:
             self._writer.close()
             self._writer = None
-        if self._read_task:
-            self._read_task.cancel()
-            self._read_task = None
+        task, self._read_task = self._read_task, None
+        if task is not None:
+            task.cancel()
+            # Run the cancelled read loop to completion now: a Task left
+            # pending when the loop stops spews "Task was destroyed but
+            # it is pending!" at interpreter teardown.
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
 
     async def _read_loop(self):
         try:
@@ -247,6 +262,7 @@ class RpcClient:
 
             traceback.print_exc()
         finally:
+            self.dead = True
             self._writer = None
             err = ConnectionLost(f"connection to {self._label or self.host}:{self.port} lost")
             for fut in self._pending.values():
@@ -320,12 +336,19 @@ class EventLoopThread:
 
     def stop(self):
         async def _drain():
-            tasks = [t for t in asyncio.all_tasks(self.loop)
-                     if t is not asyncio.current_task()]
-            for task in tasks:
-                task.cancel()
-            # let cancelled tasks run their (possibly awaiting) cleanup
-            await asyncio.gather(*tasks, return_exceptions=True)
+            # Sweep repeatedly: cancellation callbacks may spawn new
+            # tasks (ensure_future in push handlers); a task left pending
+            # at loop teardown spews "Task was destroyed but it is
+            # pending!" when it is later garbage collected.
+            for _ in range(3):
+                tasks = [t for t in asyncio.all_tasks(self.loop)
+                         if t is not asyncio.current_task()]
+                if not tasks:
+                    break
+                for task in tasks:
+                    task.cancel()
+                # let cancelled tasks run their (possibly awaiting) cleanup
+                await asyncio.gather(*tasks, return_exceptions=True)
             self.loop.stop()
 
         try:

@@ -27,6 +27,7 @@ pins).
 
 from __future__ import annotations
 
+import asyncio
 import os
 import queue
 import threading
@@ -232,7 +233,7 @@ class CoreWorker(RpcHost):
     async def _aclient_worker(self, addr: Tuple[str, int]) -> RpcClient:
         addr = (addr[0], addr[1])
         c = self._worker_clients.get(addr)
-        if c is None or not c.connected:
+        if c is None or c.dead:
             c = RpcClient(addr[0], addr[1], label=f"worker-{addr[1]}")
             self._worker_clients[addr] = c
         return c
@@ -240,7 +241,7 @@ class CoreWorker(RpcHost):
     async def _aclient_agent(self, addr: Tuple[str, int]) -> RpcClient:
         addr = (addr[0], addr[1])
         c = self._agent_clients.get(addr)
-        if c is None or not c.connected:
+        if c is None or c.dead:
             c = RpcClient(addr[0], addr[1], label=f"agent-{addr[1]}")
             self._agent_clients[addr] = c
         return c
@@ -338,8 +339,24 @@ class CoreWorker(RpcHost):
                 return {"pending": True}
         entry = self.memory.peek(oid)
         if entry is None and wait > 0 and self.memory.known(oid):
-            e = self.memory._entry(oid)
-            await self._loop().run_in_executor(None, e.event.wait, min(wait, 10.0))
+            # event-driven long-poll: a memory-store waiter wakes this
+            # coroutine on resolution — no executor thread parked per
+            # in-flight poll (a borrower fleet would exhaust the pool)
+            loop = self._loop()
+            fut = loop.create_future()
+
+            def _wake():
+                loop.call_soon_threadsafe(
+                    lambda: fut.done() or fut.set_result(None))
+
+            token = self.memory.add_waiter(oid, _wake)
+            if token is not None:
+                try:
+                    await asyncio.wait_for(fut, timeout=min(wait, 10.0))
+                except asyncio.TimeoutError:
+                    pass
+                finally:
+                    self.memory.remove_waiter(oid, token)
             entry = self.memory.peek(oid)
         if entry is not None:
             if entry.error is not None:
@@ -600,43 +617,96 @@ class CoreWorker(RpcHost):
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        """Event-driven wait (no polling; reference: src/ray/raylet/
+        wait_manager.h).  Locally-owned refs register memory-store waiter
+        callbacks fired by the IO thread on resolution; borrowed refs run
+        ONE long-poll probe each against their owner (the owner blocks
+        server-side until the object resolves), instead of a 5 ms
+        check-everything loop with a sync RPC per ref per iteration."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        ready: List[ObjectRef] = []
-        pending = list(refs)
-        while True:
-            still = []
-            for ref in pending:
-                if self.memory.ready(ref.oid) or self._ref_ready_elsewhere(ref):
-                    ready.append(ref)
-                else:
-                    still.append(ref)
-            pending = still
-            if len(ready) >= num_returns or not pending:
-                return ready, pending
-            if deadline is not None and time.monotonic() >= deadline:
-                return ready, pending
-            time.sleep(0.005)
+        cond = threading.Condition()
+        ready_idx: Set[int] = set()
+        removals: List[Tuple[str, int]] = []  # (oid, token) to clean up
+        probes: List[Any] = []  # concurrent futures wrapping probe tasks
 
-    def _ref_ready_elsewhere(self, ref: ObjectRef) -> bool:
+        def mark(idx: int) -> None:
+            with cond:
+                ready_idx.add(idx)
+                cond.notify_all()
+
+        for idx, ref in enumerate(refs):
+            oid = ref.oid
+            if self.memory.ready(oid):
+                ready_idx.add(idx)
+            elif self.memory.known(oid):
+                token = self.memory.add_waiter(oid, lambda i=idx: mark(i))
+                if token is None:  # resolved between the two checks
+                    ready_idx.add(idx)
+                else:
+                    removals.append((oid, token))
+            else:
+                coro = self._aprobe_ready(ref, idx, mark, deadline)
+                if self._shutdown:
+                    coro.close()
+                    continue
+                try:
+                    probes.append(self._io.spawn(coro))
+                except RuntimeError:
+                    coro.close()
+
+        try:
+            with cond:
+                while len(ready_idx) < min(num_returns, len(refs)):
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    if remaining is not None and remaining <= 0:
+                        break
+                    cond.wait(remaining)
+        finally:
+            # cancel probes NOW — a probe parked in a 10 s owner-side
+            # long-poll must not outlive the wait that spawned it (the
+            # poll-loop pattern `while pending: ray.wait(pending, 0.5)`
+            # would otherwise pile up ~N*(10s/timeout) live probes)
+            for f in probes:
+                f.cancel()
+            for oid, token in removals:
+                self.memory.remove_waiter(oid, token)
+        with cond:
+            snapshot = set(ready_idx)
+        ready = [r for i, r in enumerate(refs) if i in snapshot]
+        pending = [r for i, r in enumerate(refs) if i not in snapshot]
+        return ready, pending
+
+    async def _aprobe_ready(self, ref: ObjectRef, idx: int, mark,
+                            deadline) -> None:
         """Readiness probe for refs this process doesn't own: the local
-        plasma store first, then the owner (covers values inlined in the
-        owner's memory store, which never touch plasma)."""
-        if self.memory.known(ref.oid):
-            return False  # locally owned and still pending
-        try:
-            if self.plasma.contains(ref.oid):
-                return True
-        except Exception:
-            pass
-        owner = ref.owner_addr
-        if owner is None or tuple(owner) == self.address:
-            return False
-        try:
-            r = self._io.run(self._afetch_from_owner(tuple(owner), ref.oid, 0.0),
-                             timeout=15.0)
-        except Exception:
-            return False
-        return any(k in r for k in ("inline", "plasma", "error", "freed"))
+        plasma store first, then a server-side long-poll on the owner
+        (covers values inlined in the owner's memory store, which never
+        touch plasma).  Ended by cancellation from wait()'s finally."""
+        import asyncio
+
+        while True:
+            try:
+                if self.plasma.contains(ref.oid):
+                    mark(idx)
+                    return
+            except Exception:
+                pass
+            owner = ref.owner_addr
+            if owner is None or tuple(owner) == self.address:
+                return  # nothing that could ever resolve it
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                return
+            poll = 10.0 if remaining is None else min(10.0, remaining)
+            try:
+                r = await self._afetch_from_owner(tuple(owner), ref.oid, poll)
+            except Exception:
+                await asyncio.sleep(0.2)
+                continue
+            if any(k in r for k in ("inline", "plasma", "error", "freed")):
+                mark(idx)
+                return
 
     # ---------------------------------------------------------- task submit
 

@@ -512,6 +512,67 @@ class _SharedWaiter:
 _shared_waiter = _SharedWaiter()
 
 
+class _MetricsPusher:
+    """ONE daemon thread pushing windowed-average ongoing requests for
+    every live handle (reference: serve/_private/metrics_utils.py
+    MetricsPusher).  Sampling on a clock — instead of piggybacking point
+    reads on submit — keeps autoscaling correct when request completion
+    is phase-aligned with submission bursts.  Handles are held by
+    weakref: an abandoned handle (proxy re-creates them on RayError)
+    simply drops out, so no thread or GC pin leaks with handle churn."""
+
+    SAMPLE_PERIOD_S = 0.1
+    PUSH_PERIOD_S = 0.5
+    WINDOW = 20  # samples (~2 s)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handles: List[Any] = []  # weakref.ref[DeploymentHandle]
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, handle) -> None:
+        import weakref
+
+        with self._lock:
+            self._handles.append(weakref.ref(handle))
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="serve-metrics", daemon=True)
+                self._thread.start()
+
+    def _run(self):
+        while True:
+            time.sleep(self.SAMPLE_PERIOD_S)
+            with self._lock:
+                live = [(r, h) for r in self._handles
+                        if (h := r()) is not None]
+                self._handles = [r for r, _ in live]
+                if not live:
+                    self._thread = None  # retire; register() restarts
+                    return
+            now = time.monotonic()
+            for _, h in live:
+                try:
+                    self._sample_and_push(h, now)
+                except Exception:
+                    pass  # runtime down or controller restarting
+
+    def _sample_and_push(self, h, now: float) -> None:
+        with h._lock:
+            h._samples.append(sum(h._inflight.values()))
+            if len(h._samples) > self.WINDOW:
+                h._samples = h._samples[-self.WINDOW:]
+            avg = sum(h._samples) / len(h._samples)
+        if now - h._last_push < self.PUSH_PERIOD_S:
+            return
+        h._last_push = now
+        ctrl = _controller()
+        ctrl.report_metrics.remote(h._name, h._handle_id, int(round(avg)))
+
+
+_metrics_pusher = _MetricsPusher()
+
+
 class DeploymentHandle:
     """Client-side router: least-outstanding-requests replica choice
     (reference: router.py assign_request + pow_2_scheduler.py), with
@@ -529,6 +590,9 @@ class DeploymentHandle:
         self._version = version
         self._set_replicas(replica_ids)
         self._last_refresh = time.monotonic()
+        self._samples: List[int] = []  # recent inflight samples (window)
+        self._last_push = 0.0
+        _metrics_pusher.register(self)
 
     def _set_replicas(self, replica_ids: List[str]):
         from ray_tpu.api import ActorHandle
@@ -549,9 +613,6 @@ class DeploymentHandle:
         self._last_refresh = now
         try:
             ctrl = _controller()
-            with self._lock:
-                ongoing = sum(self._inflight.values())
-            ctrl.report_metrics.remote(self._name, self._handle_id, ongoing)
             info = ray_tpu.get(
                 ctrl.get_replicas.remote(self._name, self._version),
                 timeout=30)
