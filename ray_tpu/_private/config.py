@@ -41,6 +41,9 @@ _def("object_transfer_chunk_bytes", 4 * 1024 * 1024)
 # --- control plane ----------------------------------------------------------
 _def("gcs_health_check_period_ms", 3_000)   # ref: ray_config_def.h:841-847
 _def("gcs_health_check_failure_threshold", 5)
+_def("gcs_persist_interval_ms", 200)        # head table snapshot debounce
+_def("gcs_reconnect_grace_s", 15.0)         # client retry window across a
+                                            # head restart (ref: NotifyGCSRestart)
 _def("pubsub_poll_timeout_ms", 30_000)
 _def("rpc_connect_timeout_s", 10.0)
 _def("rpc_call_timeout_s", 120.0)

@@ -26,7 +26,6 @@ Services, all over the msgpack RPC plane (rpc.py):
 from __future__ import annotations
 
 import asyncio
-import itertools
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -122,7 +121,8 @@ class _ActorEntry:
 
 class _NodeEntry:
     __slots__ = ("node_id", "host", "port", "arena_path", "resources",
-                 "last_heartbeat", "client", "is_head_node")
+                 "last_heartbeat", "client", "is_head_node",
+                 "pending_demands")
 
     def __init__(self, node_id: str, host: str, port: int, arena_path: str,
                  resources: NodeResources, is_head_node: bool):
@@ -134,6 +134,9 @@ class _NodeEntry:
         self.last_heartbeat = time.monotonic()
         self.client: Optional[RpcClient] = None
         self.is_head_node = is_head_node
+        # queued + infeasible lease demands, piggybacked on heartbeats —
+        # the autoscaler's scale-up signal (reference: load_metrics.py)
+        self.pending_demands: List[Dict[str, float]] = []
 
     def table_entry(self) -> Dict[str, Any]:
         return {
@@ -146,36 +149,178 @@ class _NodeEntry:
 
 
 class HeadService(RpcHost):
-    def __init__(self):
+    def __init__(self, state_path: str = ""):
         self.nodes: Dict[str, _NodeEntry] = {}
         self.kv: Dict[str, bytes] = {}
         self.actors: Dict[str, _ActorEntry] = {}
         self.named_actors: Dict[str, str] = {}  # name -> actor_id
         self.placement_groups: Dict[str, _PgEntry] = {}
-        self._job_counter = itertools.count(1)
+        self._next_job_int = 1  # persisted; itertools.count has no peek
         self._server: Optional[RpcServer] = None
         self._health_task: Optional[asyncio.Task] = None
+        self._persist_task: Optional[asyncio.Task] = None
         self._node_conns: Dict[Any, str] = {}  # conn -> node_id
         self._cluster_version = 0  # bumped on membership change
         self._shutdown = asyncio.Event()
+        # persistence (reference: gcs/store_client/redis_store_client.h —
+        # GCS tables behind a store so the head survives restarts; we
+        # snapshot to a local file, atomic tmp+rename)
+        self._state_path = state_path
+        self._dirty = False
+        self.restarted = False  # loaded pre-existing state on boot
+        # node types an autoscaler announced it can launch
+        self._autoscaler_types: Dict[str, Dict[str, Any]] = {}
 
     # ---- lifecycle ---------------------------------------------------------
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        if self._state_path:
+            self._load_state()
         self._server = RpcServer(self, host, port)
         p = await self._server.start()
         self._health_task = asyncio.ensure_future(self._health_loop())
+        if self._state_path:
+            self._persist_task = asyncio.ensure_future(self._persist_loop())
+        # resume interrupted scheduling work from the restored tables
+        for actor in self.actors.values():
+            if actor.state in (PENDING, RESTARTING):
+                self._spawn_scheduler(actor)
+        for pg in self.placement_groups.values():
+            if pg.state == PG_PENDING:
+                asyncio.ensure_future(self._schedule_pg(pg))
         return p
 
     async def stop(self):
         if self._health_task:
             self._health_task.cancel()
+        if self._persist_task:
+            self._persist_task.cancel()
+        if self._state_path and self._dirty:
+            self._save_state()
         for n in self.nodes.values():
             if n.client is not None:
                 await n.client.close()
         if self._server:
             await self._server.stop()
         self._shutdown.set()
+
+    # ---- persistence -------------------------------------------------------
+
+    def mark_dirty(self) -> None:
+        self._dirty = True
+
+    async def _persist_loop(self):
+        interval = config.gcs_persist_interval_ms / 1000.0
+        while True:
+            await asyncio.sleep(interval)
+            if self._dirty:
+                self._dirty = False
+                try:
+                    self._save_state()
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+
+    def _snapshot(self) -> Dict[str, Any]:
+        return {
+            "kv": dict(self.kv),
+            "named_actors": dict(self.named_actors),
+            "job_counter": self._next_job_int,
+            "cluster_version": self._cluster_version,
+            "autoscaler_types": dict(self._autoscaler_types),
+            "actors": [
+                {"actor_id": a.actor_id, "spec_wire": a.spec_wire,
+                 "state": a.state, "node_id": a.node_id,
+                 "worker_id": a.worker_id,
+                 "addr": list(a.addr) if a.addr else None,
+                 "instance": a.instance, "restarts_left": a.restarts_left,
+                 "name": a.name, "death_cause": a.death_cause,
+                 "kill_requested": a.kill_requested}
+                for a in self.actors.values()],
+            "placement_groups": [
+                {"pg_id": p.pg_id, "bundles": p.bundles,
+                 "strategy": p.strategy, "state": p.state,
+                 "placements": p.placements, "name": p.name,
+                 "failure": p.failure}
+                for p in self.placement_groups.values()],
+            "nodes": [
+                {"node_id": n.node_id, "host": n.host, "port": n.port,
+                 "arena_path": n.arena_path, "is_head_node": n.is_head_node,
+                 "total": n.resources.total.to_dict(),
+                 "available": n.resources.available.to_dict()}
+                for n in self.nodes.values()],
+        }
+
+    def _save_state(self) -> None:
+        import os
+
+        import msgpack
+
+        blob = msgpack.packb(self._snapshot(), use_bin_type=True)
+        tmp = self._state_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, self._state_path)
+
+    def _load_state(self) -> None:
+        import os
+
+        import msgpack
+
+        if not os.path.exists(self._state_path):
+            return
+        try:
+            with open(self._state_path, "rb") as f:
+                snap = msgpack.unpackb(f.read(), raw=False,
+                                       strict_map_key=False)
+        except Exception as e:
+            # a corrupt snapshot must not crash-loop the head: boot empty
+            # (agents re-register via heartbeats) and keep the bad file
+            # aside for diagnosis
+            import sys
+
+            sys.stderr.write(f"head state unreadable ({e}); starting fresh\n")
+            try:
+                os.replace(self._state_path, self._state_path + ".corrupt")
+            except OSError:
+                pass
+            return
+        self.kv = dict(snap.get("kv", {}))
+        self.named_actors = dict(snap.get("named_actors", {}))
+        self._next_job_int = int(snap.get("job_counter", 1))
+        self._cluster_version = int(snap.get("cluster_version", 0))
+        self._autoscaler_types = dict(snap.get("autoscaler_types", {}))
+        for a in snap.get("actors", []):
+            entry = _ActorEntry(a["actor_id"], a["spec_wire"], a["name"], 0)
+            entry.state = a["state"]
+            entry.node_id = a["node_id"]
+            entry.worker_id = a["worker_id"]
+            entry.addr = tuple(a["addr"]) if a["addr"] else None
+            entry.instance = a["instance"]
+            entry.restarts_left = a["restarts_left"]
+            entry.death_cause = a["death_cause"]
+            entry.kill_requested = a["kill_requested"]
+            self.actors[entry.actor_id] = entry
+        for p in snap.get("placement_groups", []):
+            entry = _PgEntry(p["pg_id"], p["bundles"], p["strategy"],
+                             p["name"])
+            entry.state = p["state"]
+            entry.placements = list(p["placements"])
+            entry.failure = p["failure"]
+            self.placement_groups[entry.pg_id] = entry
+        # nodes are restored provisionally: agents keep running across a
+        # head restart and re-register on the next heartbeat (reference:
+        # node_manager.proto NotifyGCSRestart).  A restored node that
+        # never reports in is reaped by the health loop.
+        for nd in snap.get("nodes", []):
+            entry = _NodeEntry(
+                nd["node_id"], nd["host"], nd["port"], nd["arena_path"],
+                NodeResources(ResourceSet(nd["total"])),
+                nd["is_head_node"])
+            entry.resources.available = ResourceSet(nd["available"])
+            self.nodes[entry.node_id] = entry
+        self.restarted = True
 
     async def wait_for_shutdown(self):
         await self._shutdown.wait()
@@ -191,6 +336,7 @@ class HeadService(RpcHost):
         if _conn is not None:
             self._node_conns[_conn] = node_id
         self._cluster_version += 1
+        self.mark_dirty()
         self._broadcast_cluster_view()
         return {"ok": True, "cluster": self._cluster_view(),
                 "version": self._cluster_version}
@@ -202,12 +348,14 @@ class HeadService(RpcHost):
         wedged agent can't stall the others."""
         view = self._cluster_view()
         version = self._cluster_version
+        scalable = self._scalable_shapes()
 
         async def _push_one(conn):
             try:
                 await asyncio.wait_for(
                     conn.push("cluster_update",
-                              {"cluster": view, "version": version}),
+                              {"cluster": view, "version": version,
+                               "scalable": scalable}),
                     timeout=5.0)
             except Exception:
                 pass
@@ -215,13 +363,16 @@ class HeadService(RpcHost):
         for conn in list(self._node_conns):
             asyncio.ensure_future(_push_one(conn))
 
-    async def rpc_heartbeat(self, node_id: str, available: Dict[str, float]):
+    async def rpc_heartbeat(self, node_id: str, available: Dict[str, float],
+                            pending: Optional[List[Dict[str, float]]] = None):
         entry = self.nodes.get(node_id)
         if entry is None:
             return {"unknown_node": True}
         entry.last_heartbeat = time.monotonic()
         entry.resources.available = ResourceSet(available)
-        return {"cluster": self._cluster_view(), "version": self._cluster_version}
+        entry.pending_demands = pending or []
+        return {"cluster": self._cluster_view(), "version": self._cluster_version,
+                "scalable": self._scalable_shapes()}
 
     async def rpc_node_table(self):
         return {nid: n.table_entry() for nid, n in self.nodes.items()}
@@ -258,6 +409,7 @@ class HeadService(RpcHost):
         if entry is None:
             return
         self._cluster_version += 1
+        self.mark_dirty()
         self._broadcast_cluster_view()
         if entry.client is not None:
             await entry.client.close()
@@ -282,13 +434,17 @@ class HeadService(RpcHost):
         if not overwrite and key in self.kv:
             return {"added": False}
         self.kv[key] = value
+        self.mark_dirty()
         return {"added": True}
 
     async def rpc_kv_get(self, key: str):
         return {"value": self.kv.get(key)}
 
     async def rpc_kv_del(self, key: str):
-        return {"deleted": self.kv.pop(key, None) is not None}
+        deleted = self.kv.pop(key, None) is not None
+        if deleted:
+            self.mark_dirty()
+        return {"deleted": deleted}
 
     async def rpc_kv_keys(self, prefix: str = ""):
         return {"keys": [k for k in self.kv if k.startswith(prefix)]}
@@ -296,19 +452,28 @@ class HeadService(RpcHost):
     # ---- jobs --------------------------------------------------------------
 
     async def rpc_register_job(self, driver_addr: Optional[List] = None):
-        jid = JobID.from_int(next(self._job_counter))
+        jid = JobID.from_int(self._next_job_int)
+        self._next_job_int += 1
+        self.mark_dirty()
         return {"job_id": jid.hex()}
 
     # ---- actor manager -----------------------------------------------------
 
     async def rpc_create_actor(self, spec: Dict[str, Any], name: str = ""):
         ts = TaskSpec.from_wire(spec)
+        existing = self.actors.get(ts.actor_id)
+        if existing is not None:
+            # duplicate submission (client retried across a dropped reply,
+            # e.g. a head restart): the id is client-generated, so this is
+            # the SAME actor — don't double-create
+            return {"actor_id": ts.actor_id}
         if name:
-            if name in self.named_actors:
+            if self.named_actors.get(name) not in (None, ts.actor_id):
                 raise RpcError(f"actor name {name!r} already taken")
             self.named_actors[name] = ts.actor_id
         entry = _ActorEntry(ts.actor_id, spec, name, ts.max_restarts)
         self.actors[ts.actor_id] = entry
+        self.mark_dirty()
         self._spawn_scheduler(entry)
         return {"actor_id": ts.actor_id}
 
@@ -347,6 +512,7 @@ class HeadService(RpcHost):
         entry = self.actors.get(actor_id)
         if entry is None:
             return {"ok": False}
+        self.mark_dirty()
         if no_restart:
             entry.restarts_left = 0
             entry.kill_requested = True
@@ -377,6 +543,7 @@ class HeadService(RpcHost):
         return {"ok": True}
 
     async def _on_actor_worker_lost(self, actor: _ActorEntry, cause: str):
+        self.mark_dirty()
         if actor.state == RESTARTING:
             # a restart is already in flight (_schedule_actor retries node
             # failures itself); a second concurrent reschedule would double
@@ -440,7 +607,9 @@ class HeadService(RpcHost):
                     break
                 await asyncio.sleep(delay)
                 delay = min(delay * 2, 1.0)
-        for attempt in range(config.actor_creation_retries + 1):
+        attempt = 0
+        while attempt <= config.actor_creation_retries:
+            attempt += 1
             if (actor.kill_requested or actor.state == DEAD
                     or actor.sched_gen != gen):
                 return
@@ -455,6 +624,13 @@ class HeadService(RpcHost):
                 cluster = {nid: n.resources for nid, n in self.nodes.items()}
                 nid = pick_node(cluster, demand, local_node_id="")
             if nid is None:
+                if any(ResourceSet(s).fits(demand)
+                       for s in self._scalable_shapes()):
+                    # an autoscaler can launch a node this actor fits:
+                    # keep the actor PENDING (visible via autoscaler_state)
+                    # without spending the creation budget (reference:
+                    # pending actors resolve via the autoscaler demand loop)
+                    attempt -= 1
                 await asyncio.sleep(delay)
                 delay = min(delay * 2, 2.0)
                 continue
@@ -541,10 +717,12 @@ class HeadService(RpcHost):
             actor.node_id = nid
             actor.worker_id = g["worker_id"]
             actor.addr = (g["addr"][0], g["addr"][1])
+            self.mark_dirty()
             actor.wake()
             return
         actor.state = DEAD
         actor.death_cause = "actor creation failed: no feasible node"
+        self.mark_dirty()
         if actor.name:
             self.named_actors.pop(actor.name, None)
         actor.wake()
@@ -558,12 +736,18 @@ class HeadService(RpcHost):
 
     async def rpc_create_placement_group(self, bundles: List[Dict[str, float]],
                                          strategy: str = "PACK",
-                                         name: str = ""):
+                                         name: str = "", pg_id: str = ""):
         from ray_tpu._private.ids import PlacementGroupID
 
-        pg_id = PlacementGroupID.from_random().hex()
+        if pg_id and pg_id in self.placement_groups:
+            # duplicate submission (client retried across a dropped
+            # reply): ids are client-generated, dedup instead of leaking
+            # a second group holding bundles forever
+            return {"pg_id": pg_id}
+        pg_id = pg_id or PlacementGroupID.from_random().hex()
         entry = _PgEntry(pg_id, bundles, strategy, name)
         self.placement_groups[pg_id] = entry
+        self.mark_dirty()
         asyncio.ensure_future(self._schedule_pg(entry))
         return {"pg_id": pg_id}
 
@@ -592,6 +776,7 @@ class HeadService(RpcHost):
         if entry is None:
             return {"ok": False}
         entry.state = PG_REMOVED
+        self.mark_dirty()
         entry.wake()
         for idx, nid in enumerate(entry.placements):
             node = self.nodes.get(nid) if nid else None
@@ -685,6 +870,7 @@ class HeadService(RpcHost):
                         continue  # replan from scratch
                     entry.placements = plan
                     entry.state = PG_CREATED
+                    self.mark_dirty()
                     entry.wake()
                     return
             await asyncio.sleep(delay)
@@ -728,10 +914,66 @@ class HeadService(RpcHost):
         for entry in self.placement_groups.values():
             if entry.state == PG_CREATED and node_id in entry.placements:
                 entry.state = PG_PENDING
+                self.mark_dirty()
                 for idx, nid in enumerate(entry.placements):
                     if nid == node_id:
                         entry.placements[idx] = None
                 asyncio.ensure_future(self._schedule_pg(entry))
+
+    # ---- autoscaler --------------------------------------------------------
+
+    def _scalable_shapes(self) -> List[Dict[str, float]]:
+        """Resource totals of node types the autoscaler can still launch
+        (lets agents park infeasible-but-scalable demands instead of
+        failing them; reference: autoscaler hints in load_metrics)."""
+        shapes: List[Dict[str, float]] = []
+        for t in self._autoscaler_types.values():
+            shapes.append(dict(t.get("resources", {})))
+        return shapes
+
+    async def rpc_register_autoscaler(self, node_types: Dict[str, Any]):
+        """An autoscaler announces the node types it can launch
+        (reference: monitor.py registering with GCS).  Idempotent — the
+        autoscaler re-registers every pass, so a restarted head relearns
+        the types within one update period."""
+        if dict(node_types) == self._autoscaler_types:
+            return {"ok": True}
+        self._autoscaler_types = dict(node_types)
+        self._cluster_version += 1
+        self.mark_dirty()
+        self._broadcast_cluster_view()
+        return {"ok": True}
+
+    async def rpc_autoscaler_state(self):
+        """Aggregate demand + supply snapshot for the autoscaler loop
+        (reference: gcs_autoscaler_state_manager.h GetClusterResourceState)."""
+        pending_pg_bundles: List[Dict[str, Any]] = []
+        for pg in self.placement_groups.values():
+            if pg.state == PG_PENDING:
+                for idx, nid in enumerate(pg.placements):
+                    if nid is None:
+                        pending_pg_bundles.append(
+                            {"pg_id": pg.pg_id, "strategy": pg.strategy,
+                             "resources": pg.bundles[idx]})
+        pending_actors: List[Dict[str, float]] = []
+        for actor in self.actors.values():
+            if actor.state in (PENDING, RESTARTING):
+                try:
+                    ts = TaskSpec.from_wire(actor.spec_wire)
+                    pending_actors.append(ts.resource_set().to_dict())
+                except Exception:
+                    pass
+        return {
+            "nodes": [
+                {"node_id": n.node_id, "is_head_node": n.is_head_node,
+                 "total": n.resources.total.to_dict(),
+                 "available": n.resources.available.to_dict(),
+                 "pending": n.pending_demands,
+                 "heartbeat_age_s": time.monotonic() - n.last_heartbeat}
+                for n in self.nodes.values()],
+            "pending_pg_bundles": pending_pg_bundles,
+            "pending_actors": pending_actors,
+        }
 
     # ---- misc --------------------------------------------------------------
 
@@ -771,10 +1013,12 @@ def main():
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--port-file", default="")
+    ap.add_argument("--state-path", default="",
+                    help="persist head tables here; reloaded on restart")
     args = ap.parse_args()
 
     async def run():
-        svc = HeadService()
+        svc = HeadService(state_path=args.state_path)
         port = await svc.start(args.host, args.port)
         if args.port_file:
             tmp = args.port_file + ".tmp"

@@ -70,23 +70,26 @@ def default_resources(num_cpus: Optional[float] = None,
     return out
 
 
-def start_head(session_dir: str, env: Optional[Dict[str, str]] = None
-               ) -> Tuple[ProcessHandle, Tuple[str, int]]:
+def start_head(session_dir: str, env: Optional[Dict[str, str]] = None,
+               port: int = 0) -> Tuple[ProcessHandle, Tuple[str, int]]:
     from ray_tpu._private.spawn import fast_python_cmd
 
-    port_file = os.path.join(session_dir, "head.port")
+    port_file = os.path.join(session_dir, f"head-{time.monotonic_ns()}.port")
+    state_path = os.path.join(session_dir, "head.state")
     log = open(os.path.join(session_dir, "logs", "head.log"), "ab")
     penv = dict(os.environ)
     if env:
         penv.update(env)
-    cmd, env_up = fast_python_cmd("ray_tpu._private.head",
-                                  ["--port-file", port_file])
+    cmd, env_up = fast_python_cmd(
+        "ray_tpu._private.head",
+        ["--port-file", port_file, "--state-path", state_path,
+         "--port", str(port)])
     penv.update(env_up)
     proc = subprocess.Popen(
         cmd, stdout=log, stderr=subprocess.STDOUT, env=penv, start_new_session=True)
     log.close()
-    port = int(_wait_for_file(port_file))
-    return ProcessHandle("head", proc), ("127.0.0.1", port)
+    bound = int(_wait_for_file(port_file))
+    return ProcessHandle("head", proc), ("127.0.0.1", bound)
 
 
 def start_node_agent(session_dir: str, head_addr: Tuple[str, int],

@@ -359,26 +359,46 @@ class EventLoopThread:
 
 
 class SyncRpcClient:
-    """Blocking facade over RpcClient for use from the main thread."""
+    """Blocking facade over RpcClient for use from the main thread.
 
-    def __init__(self, host: str, port: int, io: EventLoopThread, on_push=None, label=""):
+    With ``retry_lost_s`` > 0, calls that fail on connection loss or
+    refusal retry (with backoff) until the window closes — this is what
+    lets drivers and workers ride out a head restart
+    (reference: gcs_rpc_client.h retryable GCS client).
+    """
+
+    def __init__(self, host: str, port: int, io: EventLoopThread, on_push=None,
+                 label="", retry_lost_s: float = 0.0):
         self._io = io
         self._client = RpcClient(host, port, on_push=on_push, label=label)
+        self._retry_lost_s = retry_lost_s
 
     @property
     def aio(self) -> RpcClient:
         return self._client
 
     def call(self, method: str, timeout: Optional[float] = None, **payload) -> Any:
+        import time as _time
+
         from ray_tpu._private.config import config
 
         # Outer margin over the inner asyncio timeout so a wedged IO loop
         # cannot block the caller forever.
         inner = timeout if timeout is not None else config.rpc_call_timeout_s
-        return self._io.run(
-            self._client.call(method, timeout=timeout, **payload),
-            timeout=inner + 30.0,
-        )
+        deadline = _time.monotonic() + self._retry_lost_s
+        delay = 0.05
+        while True:
+            try:
+                return self._io.run(
+                    self._client.call(method, timeout=timeout, **payload),
+                    timeout=inner + 30.0,
+                )
+            except (ConnectionLost, ConnectionRefusedError, OSError,
+                    asyncio.TimeoutError):
+                if _time.monotonic() >= deadline:
+                    raise
+                _time.sleep(min(delay, max(0.0, deadline - _time.monotonic())))
+                delay = min(delay * 2, 1.0)
 
     def oneway(self, method: str, **payload) -> None:
         from ray_tpu._private.config import config

@@ -178,7 +178,9 @@ class CoreWorker(RpcHost):
         self._server = RpcServer(self, "127.0.0.1", 0)
         port = self._io.run(self._server.start())
         self.address: Tuple[str, int] = ("127.0.0.1", port)
-        self.head = SyncRpcClient(head_addr[0], head_addr[1], self._io, label="head")
+        self.head = SyncRpcClient(head_addr[0], head_addr[1], self._io,
+                                  label="head",
+                                  retry_lost_s=config.gcs_reconnect_grace_s)
         self.agent = SyncRpcClient(agent_addr[0], agent_addr[1], self._io, label="agent")
         if not job_id:
             job_id = self.head.call("register_job")["job_id"]
@@ -699,6 +701,7 @@ class CoreWorker(RpcHost):
             if remaining is not None and remaining <= 0:
                 return
             poll = 10.0 if remaining is None else min(10.0, remaining)
+            t0 = time.monotonic()
             try:
                 r = await self._afetch_from_owner(tuple(owner), ref.oid, poll)
             except Exception:
@@ -707,6 +710,11 @@ class CoreWorker(RpcHost):
             if any(k in r for k in ("inline", "plasma", "error", "freed")):
                 mark(idx)
                 return
+            if time.monotonic() - t0 < 0.5:
+                # the owner answered without long-polling (e.g. "unknown"
+                # for an evicted entry): pace the loop or it spins RPCs
+                # at round-trip rate until the wait deadline
+                await asyncio.sleep(0.5)
 
     # ---------------------------------------------------------- task submit
 

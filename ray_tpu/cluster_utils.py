@@ -77,6 +77,27 @@ class Cluster:
         if node in self.nodes:
             self.nodes.remove(node)
 
+    def restart_head(self, kill: bool = True) -> None:
+        """Kill the head process and restart it on the SAME port from its
+        persisted state (reference: GCS fault tolerance via Redis-backed
+        store, tests/test_gcs_fault_tolerance.py).  Agents re-register on
+        their next heartbeat; drivers ride out the window via the head
+        client's retry-on-connection-loss."""
+        port = self.head_addr[1]
+        if kill:
+            try:
+                os.kill(self._head_proc.proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        else:
+            self._head_proc.terminate()
+        try:
+            self._head_proc.proc.wait(timeout=5)
+        except Exception:
+            pass
+        self._head_proc, self.head_addr = node_mod.start_head(
+            self.session_dir, port=port)
+
     def wait_for_nodes(self, count: Optional[int] = None,
                        timeout: float = 30.0) -> None:
         """Block until the head's node table has `count` live entries."""
@@ -98,3 +119,40 @@ class Cluster:
             node.proc.terminate()
         self.nodes = []
         self._head_proc.terminate()
+
+
+class AutoscalingCluster:
+    """A head node plus an autoscaler over the fake provider — scale-up/
+    down testable on one machine (reference: cluster_utils.py:26
+    AutoscalingCluster + FakeMultiNodeProvider)."""
+
+    def __init__(self, head_resources: Optional[Dict[str, float]] = None,
+                 worker_node_types: Optional[Dict[str, Any]] = None,
+                 idle_timeout_s: float = 60.0,
+                 update_period_s: float = 0.5):
+        from ray_tpu.autoscaler import (AutoscalerConfig,
+                                        FakeMultiNodeProvider,
+                                        StandardAutoscaler)
+
+        head_resources = head_resources or {"CPU": 2}
+        self.cluster = Cluster(initialize_head=True, head_node_args={
+            "num_cpus": head_resources.get("CPU", 2),
+            "resources": {k: v for k, v in head_resources.items()
+                          if k != "CPU"}})
+        self.provider = FakeMultiNodeProvider(
+            self.cluster.session_dir, self.cluster.head_addr)
+        self.autoscaler = StandardAutoscaler(
+            self.cluster.head_addr, self.provider,
+            AutoscalerConfig(worker_node_types or {},
+                             idle_timeout_s=idle_timeout_s,
+                             update_period_s=update_period_s))
+        self.autoscaler.start()
+
+    @property
+    def address(self) -> str:
+        return self.cluster.address
+
+    def shutdown(self) -> None:
+        self.autoscaler.stop()
+        self.provider.shutdown()
+        self.cluster.shutdown()

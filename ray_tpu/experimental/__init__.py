@@ -1,0 +1,1 @@
+from ray_tpu.experimental import internal_kv  # noqa: F401

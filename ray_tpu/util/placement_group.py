@@ -80,10 +80,23 @@ def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
         raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
     if not bundles:
         raise ValueError("placement group needs at least one bundle")
+    from ray_tpu._private.ids import PlacementGroupID
+
     w = ray_tpu.api._worker()
+    # client-generated id makes the create idempotent across retries
     reply = w.head.call("create_placement_group", bundles=list(bundles),
-                        strategy=strategy, name=name)
+                        strategy=strategy, name=name,
+                        pg_id=PlacementGroupID.from_random().hex())
     return PlacementGroup(reply["pg_id"], list(bundles))
+
+
+def placement_group_table() -> List[Dict]:
+    """All placement groups with states and placements
+    (reference: python/ray/util/placement_group.py placement_group_table)."""
+    import ray_tpu
+
+    w = ray_tpu.api._worker()
+    return w.head.call("list_placement_groups")["placement_groups"]
 
 
 def remove_placement_group(pg: PlacementGroup) -> None:
