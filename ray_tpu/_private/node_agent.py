@@ -104,6 +104,10 @@ class NodeAgent(RpcHost):
         self._pulls: Dict[str, asyncio.Future] = {}
         self._tasks: List[asyncio.Task] = []
         self._shutdown = asyncio.Event()
+        # infeasible-but-scalable lease demands, parked while the
+        # autoscaler grows the cluster: key -> (demand dict, expiry)
+        self._infeasible: Dict[str, Tuple[Dict[str, float], float]] = {}
+        self.scalable_shapes: List[ResourceSet] = []
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -153,9 +157,11 @@ class NodeAgent(RpcHost):
     async def wait_for_shutdown(self):
         await self._shutdown.wait()
 
-    def _apply_cluster_view(self, view, version) -> None:
+    def _apply_cluster_view(self, view, version, scalable=None) -> None:
         """Last-write-wins would let an older RPC-reply snapshot clobber a
         fresher pushed view; only apply monotonically newer versions."""
+        if scalable is not None:
+            self.scalable_shapes = [ResourceSet(s) for s in scalable]
         if view is None:
             return
         if version is None:
@@ -166,7 +172,18 @@ class NodeAgent(RpcHost):
 
     def _on_head_push(self, method: str, payload):
         if method == "cluster_update":
-            self._apply_cluster_view(payload.get("cluster"), payload.get("version"))
+            self._apply_cluster_view(payload.get("cluster"),
+                                     payload.get("version"),
+                                     payload.get("scalable"))
+
+    def _pending_for_heartbeat(self) -> List[Dict[str, float]]:
+        """Queued lease demands plus parked infeasible-but-scalable
+        demands (the autoscaler's input; reference: load_metrics.py)."""
+        now = time.monotonic()
+        self._infeasible = {k: v for k, v in self._infeasible.items()
+                            if v[1] > now}
+        return (self.local.pending_demands()
+                + [dict(d) for d, _ in self._infeasible.values()])
 
     async def _heartbeat_loop(self):
         period = config.gcs_health_check_period_ms / 1000.0
@@ -175,10 +192,24 @@ class NodeAgent(RpcHost):
                 reply = await self._head.call(
                     "heartbeat", node_id=self.node_id,
                     available=self.resources.available.to_dict(),
-                    pending=self.local.pending_demands())
-                self._apply_cluster_view(reply.get("cluster"), reply.get("version"))
+                    pending=self._pending_for_heartbeat())
+                if reply.get("unknown_node"):
+                    # the head restarted without our entry (or reaped us
+                    # during its downtime): re-register under the SAME
+                    # node id so live actor/PG records stay valid
+                    # (reference: node_manager.proto:352 NotifyGCSRestart
+                    # — raylets resync after a GCS restart)
+                    reply = await self._head.call(
+                        "register_node", node_id=self.node_id,
+                        host=self.host, port=self.port,
+                        arena_path=self.arena_path,
+                        resources=self.resources.total.to_dict(),
+                        is_head_node=self.is_head_node)
+                self._apply_cluster_view(reply.get("cluster"),
+                                         reply.get("version"),
+                                         reply.get("scalable"))
             except Exception:
-                pass
+                pass  # head unreachable (possibly restarting) — keep trying
             await asyncio.sleep(period)
 
     # ---- object store RPCs (PlasmaClient protocol) -------------------------
@@ -452,6 +483,18 @@ class NodeAgent(RpcHost):
                 top_k_fraction=config.scheduler_top_k_fraction,
                 top_k_absolute=config.scheduler_top_k_absolute)
             if target is None:
+                if self._demand_is_scalable(demand):
+                    # an autoscaler can launch a node this fits: park the
+                    # demand (visible to the scale-up loop via heartbeat)
+                    # and tell the submitter to keep waiting — mirrors the
+                    # reference, where infeasible tasks pend until the
+                    # autoscaler resolves them (autoscaler.py demand loop)
+                    key = repr(sorted(demand.to_dict().items()))
+                    self._infeasible[key] = (demand.to_dict(),
+                                             time.monotonic() + 30.0)
+                    await asyncio.sleep(1.0)  # pace the submitter's retries
+                    return {"error": "lease timeout",
+                            "error_str": "waiting for cluster scale-up"}
                 return {"error": "infeasible",
                         "error_str": f"no node can ever satisfy {demand.to_dict()}"}
             if target != self.node_id:
@@ -462,6 +505,10 @@ class NodeAgent(RpcHost):
             return {"error": "infeasible",
                     "error_str": f"node cannot satisfy {demand.to_dict()}"}
         return await self._acquire_and_grant(self.local, demand, "")
+
+    def _demand_is_scalable(self, demand: ResourceSet) -> bool:
+        """True if some autoscaler-launchable node type could fit this."""
+        return any(shape.fits(demand) for shape in self.scalable_shapes)
 
     async def _request_bundle_lease(self, ts: TaskSpec, demand: ResourceSet):
         sched, key = self._sched_for(ts)

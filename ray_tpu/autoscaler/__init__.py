@@ -1,0 +1,6 @@
+from ray_tpu.autoscaler.autoscaler import (AutoscalerConfig,  # noqa: F401
+                                           StandardAutoscaler)
+from ray_tpu.autoscaler.fake_provider import \
+    FakeMultiNodeProvider  # noqa: F401
+from ray_tpu.autoscaler.node_provider import (NodeProvider,  # noqa: F401
+                                              ProviderNode)

@@ -1,0 +1,203 @@
+"""Demand-driven autoscaler v1.
+
+Equivalent of the reference's StandardAutoscaler + ResourceDemandScheduler
+(reference: python/ray/autoscaler/_private/autoscaler.py,
+resource_demand_scheduler.py, monitor.py): a loop that
+
+  1. reads the cluster's demand/supply snapshot from the head
+     (queued + parked-infeasible lease demands, PENDING placement-group
+     bundles, PENDING actors — the same three demand sources the
+     reference bin-packs from load_metrics),
+  2. bin-packs unmet demand into `available_node_types` and launches
+     what's missing through a NodeProvider,
+  3. drains and terminates nodes that have sat idle past the timeout
+     (never below min_workers, never the head node).
+
+TPU slices are atomic launch groups: a node type with ``launch_group: k``
+always launches k hosts together (one ICI-connected slice), mirroring
+how the reference's GCPTPU provider brings up whole TPU pods
+(reference: gcp/node.py:191, tpu_command_runner.py fans to all hosts).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.resources import ResourceSet
+from ray_tpu._private.rpc import EventLoopThread, SyncRpcClient
+from ray_tpu.autoscaler.node_provider import NodeProvider, ProviderNode
+
+
+class AutoscalerConfig:
+    def __init__(self, node_types: Dict[str, Dict[str, Any]],
+                 idle_timeout_s: float = 60.0,
+                 update_period_s: float = 1.0):
+        """node_types: {name: {"resources": {...}, "min_workers": 0,
+        "max_workers": N, "launch_group": 1}}"""
+        self.node_types = node_types
+        self.idle_timeout_s = idle_timeout_s
+        self.update_period_s = update_period_s
+
+
+class StandardAutoscaler:
+    def __init__(self, head_addr, provider: NodeProvider,
+                 config: AutoscalerConfig):
+        self.provider = provider
+        self.config = config
+        self._io = EventLoopThread(name="autoscaler-io")
+        self.head = SyncRpcClient(head_addr[0], head_addr[1], self._io,
+                                  label="head", retry_lost_s=15.0)
+        self._idle_since: Dict[str, float] = {}  # cluster node id -> t
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._registration = {
+            name: {"resources": t.get("resources", {})}
+            for name, t in config.node_types.items()}
+        self.head.call("register_autoscaler", node_types=self._registration)
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="autoscaler",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.head.close()
+        self._io.stop()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.config.update_period_s):
+            try:
+                self.update()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+    # ---- one reconcile pass ------------------------------------------------
+
+    def update(self) -> None:
+        # idempotent re-registration: a restarted head relearns the node
+        # types it can ask us for within one pass
+        self.head.call("register_autoscaler", node_types=self._registration)
+        state = self.head.call("autoscaler_state")
+        demands = self._collect_demands(state)
+        unmet = self._fit_on_existing(state, demands)
+        self._scale_up(unmet)
+        self._enforce_min_workers()
+        self._scale_down(state)
+
+    def _collect_demands(self, state) -> List[ResourceSet]:
+        demands: List[ResourceSet] = []
+        for n in state["nodes"]:
+            demands.extend(ResourceSet(d) for d in n["pending"])
+        demands.extend(ResourceSet(b["resources"])
+                       for b in state["pending_pg_bundles"])
+        demands.extend(ResourceSet(d) for d in state["pending_actors"])
+        return demands
+
+    def _fit_on_existing(self, state, demands: List[ResourceSet]
+                         ) -> List[ResourceSet]:
+        """First-fit-decreasing onto current availability; the leftovers
+        are what new capacity must cover."""
+        frees = [ResourceSet(n["available"]) for n in state["nodes"]
+                 if n["heartbeat_age_s"] < 30.0]
+        unmet: List[ResourceSet] = []
+        for d in sorted(demands, key=lambda r: -sum(r.to_dict().values())):
+            for i, free in enumerate(frees):
+                if free.fits(d):
+                    frees[i] = free.subtract(d)
+                    break
+            else:
+                unmet.append(d)
+        return unmet
+
+    def _counts_by_type(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for node in self.provider.non_terminated_nodes():
+            counts[node.node_type] = counts.get(node.node_type, 0) + 1
+        return counts
+
+    def _scale_up(self, unmet: List[ResourceSet]) -> None:
+        if not unmet:
+            return
+        counts = self._counts_by_type()
+        planned: List[List[Any]] = []  # [node_type, remaining ResourceSet]
+        to_launch: Dict[str, int] = {}
+        for d in unmet:
+            placed = False
+            for p in planned:
+                if p[1].fits(d):
+                    p[1] = p[1].subtract(d)
+                    placed = True
+                    break
+            if placed:
+                continue
+            for name, t in self.config.node_types.items():
+                shape = ResourceSet(t.get("resources", {}))
+                if not shape.fits(d):
+                    continue
+                group = max(1, int(t.get("launch_group", 1)))
+                have = counts.get(name, 0) + to_launch.get(name, 0)
+                if have + group > int(t.get("max_workers", 8)):
+                    continue
+                to_launch[name] = to_launch.get(name, 0) + group
+                fresh = [[name, ResourceSet(t.get("resources", {}))]
+                         for _ in range(group)]
+                fresh[0][1] = fresh[0][1].subtract(d)
+                planned.extend(fresh)
+                break
+            # no type fits: the demand is truly infeasible — the agent
+            # will fail it through the normal infeasible path
+        for name, count in to_launch.items():
+            t = self.config.node_types[name]
+            self.provider.create_node(name, dict(t.get("resources", {})),
+                                      count)
+
+    def _enforce_min_workers(self) -> None:
+        counts = self._counts_by_type()
+        for name, t in self.config.node_types.items():
+            deficit = int(t.get("min_workers", 0)) - counts.get(name, 0)
+            if deficit > 0:
+                self.provider.create_node(
+                    name, dict(t.get("resources", {})), deficit)
+
+    def _scale_down(self, state) -> None:
+        now = time.monotonic()
+        by_cluster_id: Dict[str, ProviderNode] = {
+            n.cluster_node_id: n
+            for n in self.provider.non_terminated_nodes()
+            if n.cluster_node_id}
+        counts = self._counts_by_type()
+        live_ids = set()
+        for n in state["nodes"]:
+            nid = n["node_id"]
+            live_ids.add(nid)
+            pnode = by_cluster_id.get(nid)
+            if pnode is None or n["is_head_node"]:
+                continue
+            busy = (n["pending"]
+                    or ResourceSet(n["total"]) != ResourceSet(n["available"]))
+            if busy:
+                self._idle_since.pop(nid, None)
+                continue
+            since = self._idle_since.setdefault(nid, now)
+            t = self.config.node_types.get(pnode.node_type, {})
+            if (now - since >= self.config.idle_timeout_s
+                    and counts.get(pnode.node_type, 0)
+                    > int(t.get("min_workers", 0))):
+                try:
+                    self.head.call("drain_node", node_id=nid)
+                except Exception:
+                    pass
+                self.provider.terminate_node(pnode.provider_id)
+                self._idle_since.pop(nid, None)
+                counts[pnode.node_type] = counts.get(pnode.node_type, 1) - 1
+        self._idle_since = {k: v for k, v in self._idle_since.items()
+                            if k in live_ids}

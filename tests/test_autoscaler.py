@@ -1,0 +1,117 @@
+"""Autoscaler tests over the fake node provider.
+
+Mirrors the reference's fake-multinode autoscaler suite
+(reference: python/ray/tests/test_autoscaler_fake_multinode.py;
+autoscaler/_private/autoscaler.py demand loop,
+resource_demand_scheduler.py bin-packing): infeasible work parks as
+demand, the autoscaler launches local node-agent processes to satisfy
+it, idle nodes are reaped.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import AutoscalingCluster
+
+
+@pytest.fixture
+def autoscaling_cluster():
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 2},
+        worker_node_types={
+            "cpu-worker": {"resources": {"CPU": 4}, "min_workers": 0,
+                           "max_workers": 2},
+            "tpu-worker": {"resources": {"CPU": 2, "TPU": 4},
+                           "min_workers": 0, "max_workers": 2},
+        },
+        idle_timeout_s=2.0, update_period_s=0.3)
+    ray_tpu.init(address=cluster.address)
+    try:
+        yield cluster
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_scale_up_on_infeasible_task(autoscaling_cluster):
+    """A {"CPU": 4} task cannot fit the 2-CPU head; the autoscaler must
+    launch a cpu-worker and the task must then run (reference:
+    autoscaler.py resolves infeasibility — the task pends, not fails)."""
+    @ray_tpu.remote(num_cpus=4)
+    def big():
+        return "scaled"
+
+    assert ray_tpu.get(big.remote(), timeout=120) == "scaled"
+    assert len(autoscaling_cluster.provider.non_terminated_nodes()) >= 1
+
+
+def test_scale_up_for_tpu_resource(autoscaling_cluster):
+    @ray_tpu.remote(resources={"TPU": 4})
+    def tpu_task():
+        return "tpu"
+
+    assert ray_tpu.get(tpu_task.remote(), timeout=120) == "tpu"
+    types = [n.node_type for n in
+             autoscaling_cluster.provider.non_terminated_nodes()]
+    assert "tpu-worker" in types
+
+
+def test_pending_actor_triggers_scale_up(autoscaling_cluster):
+    @ray_tpu.remote(num_cpus=4)
+    class Big:
+        def ping(self):
+            return "actor-scaled"
+
+    a = Big.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=120) == "actor-scaled"
+
+
+def test_pending_pg_triggers_scale_up(autoscaling_cluster):
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    pg = placement_group([{"CPU": 4}], strategy="PACK")
+    assert pg.wait(timeout=120), "placement group never became ready"
+    remove_placement_group(pg)
+
+
+def test_idle_nodes_scale_down(autoscaling_cluster):
+    @ray_tpu.remote(num_cpus=4)
+    def big():
+        return 1
+
+    assert ray_tpu.get(big.remote(), timeout=120) == 1
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if not autoscaling_cluster.provider.non_terminated_nodes():
+            return
+        time.sleep(0.5)
+    raise AssertionError("idle worker was never scaled down")
+
+
+def test_max_workers_cap(autoscaling_cluster):
+    """More demand than max_workers allows: cluster grows to the cap and
+    work completes there (queued, not failed)."""
+    @ray_tpu.remote(num_cpus=4)
+    def big(i):
+        time.sleep(0.2)
+        return i
+
+    refs = [big.remote(i) for i in range(8)]
+    assert sorted(ray_tpu.get(refs, timeout=180)) == list(range(8))
+    cpu_workers = [n for n in
+                   autoscaling_cluster.provider.non_terminated_nodes()
+                   if n.node_type == "cpu-worker"]
+    assert len(cpu_workers) <= 2
+
+
+def test_truly_infeasible_still_errors(autoscaling_cluster):
+    """Demand no configured node type can ever satisfy fails fast."""
+    @ray_tpu.remote(resources={"GPU": 8})
+    def impossible():
+        return 0
+
+    with pytest.raises(ray_tpu.SchedulingError):
+        ray_tpu.get(impossible.remote(), timeout=60)
