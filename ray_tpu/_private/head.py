@@ -170,6 +170,11 @@ class HeadService(RpcHost):
         self.restarted = False  # loaded pre-existing state on boot
         # node types an autoscaler announced it can launch
         self._autoscaler_types: Dict[str, Dict[str, Any]] = {}
+        # task-event store: merged record per task, insertion-ordered so
+        # the oldest fall off at the cap (reference: gcs_task_manager.h)
+        self.task_events: Dict[str, Dict[str, Any]] = {}
+        self._metrics_server = None
+        self.metrics_port = 0
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -181,6 +186,7 @@ class HeadService(RpcHost):
         self._health_task = asyncio.ensure_future(self._health_loop())
         if self._state_path:
             self._persist_task = asyncio.ensure_future(self._persist_loop())
+        await self._start_metrics(host)
         # resume interrupted scheduling work from the restored tables
         for actor in self.actors.values():
             if actor.state in (PENDING, RESTARTING):
@@ -200,6 +206,8 @@ class HeadService(RpcHost):
         for n in self.nodes.values():
             if n.client is not None:
                 await n.client.close()
+        if self._metrics_server is not None:
+            self._metrics_server.close()
         if self._server:
             await self._server.stop()
         self._shutdown.set()
@@ -919,6 +927,105 @@ class HeadService(RpcHost):
                     if nid == node_id:
                         entry.placements[idx] = None
                 asyncio.ensure_future(self._schedule_pg(entry))
+
+    # ---- metrics + task events (observability plane) -----------------------
+
+    async def _start_metrics(self, host: str) -> None:
+        """Prometheus endpoint with control-plane gauges
+        (reference: stats/metric_defs.cc via the reporter agent)."""
+        from ray_tpu._private.metrics import (Gauge, default_registry,
+                                              start_metrics_http_server)
+
+        nodes_g = Gauge("rt_head_nodes", "live nodes in the cluster")
+        actors_g = Gauge("rt_head_actors", "actors by state")
+        pgs_g = Gauge("rt_head_placement_groups", "placement groups by state")
+        tasks_g = Gauge("rt_head_task_events", "task event records held")
+
+        def collect():
+            nodes_g.set(len(self.nodes))
+            # seed every state with 0 so a series whose count drops to
+            # zero reports 0 instead of its stale last value
+            states = {s: 0 for s in (PENDING, ALIVE, RESTARTING, DEAD)}
+            for a in self.actors.values():
+                states[a.state] = states.get(a.state, 0) + 1
+            for s, n in states.items():
+                actors_g.set(n, tags={"state": s})
+            pstates = {s: 0 for s in (PG_PENDING, PG_CREATED, PG_REMOVED)}
+            for p in self.placement_groups.values():
+                pstates[p.state] = pstates.get(p.state, 0) + 1
+            for s, n in pstates.items():
+                pgs_g.set(n, tags={"state": s})
+            tasks_g.set(len(self.task_events))
+
+        default_registry.add_collector(collect)
+        try:
+            self._metrics_server, self.metrics_port = \
+                await start_metrics_http_server(default_registry, host)
+        except Exception:
+            self.metrics_port = 0  # observability must never block boot
+
+    async def rpc_task_events(self, events: List[Dict[str, Any]]):
+        """Workers flush task state transitions here in batches
+        (reference: task_event_buffer.h -> gcs_task_manager.h)."""
+        rank = {"SUBMITTED": 0, "RUNNING": 1, "FINISHED": 2, "FAILED": 2}
+        for ev in events:
+            tid = ev.get("task_id", "")
+            if not tid:
+                continue
+            rec = self.task_events.get(tid)
+            if rec is None:
+                rec = self.task_events[tid] = {"task_id": tid}
+            for k, v in ev.items():
+                if v is None:
+                    continue
+                if k == "state":
+                    # owner (SUBMITTED) and executor (RUNNING/...) flush
+                    # on independent clocks; a late-arriving earlier
+                    # state must not regress the record
+                    if rank.get(v, 0) < rank.get(rec.get("state"), -1):
+                        continue
+                rec[k] = v
+        cap = config.task_events_buffer_size
+        while len(self.task_events) > cap:
+            self.task_events.pop(next(iter(self.task_events)))
+        return {"ok": True}
+
+    async def rpc_list_tasks(self, state: str = "", name: str = "",
+                             limit: int = 1000):
+        out = []
+        for rec in reversed(list(self.task_events.values())):
+            if state and rec.get("state") != state:
+                continue
+            if name and rec.get("name") != name:
+                continue
+            out.append(rec)
+            if len(out) >= limit:
+                break
+        return {"tasks": out}
+
+    async def rpc_metrics_port(self):
+        return {"port": self.metrics_port}
+
+    async def rpc_list_objects(self, limit: int = 1000):
+        """Fan out to every agent's plasma store (reference:
+        state_aggregator.py querying raylets via GetObjectsInfo)."""
+        async def one(node):
+            try:
+                r = await self._node_client(node).call(
+                    "list_objects", limit=limit, timeout=10.0)
+            except Exception:
+                return []
+            objs = r.get("objects", [])
+            for o in objs:
+                o["node_id"] = node.node_id
+            return objs
+
+        # concurrent fan-out: one slow/unreachable agent bounds latency,
+        # it doesn't sum across nodes
+        results = await asyncio.gather(
+            *(one(n) for n in list(self.nodes.values())))
+        out: List[Dict[str, Any]] = [o for objs in results for o in objs]
+        return {"objects": out[:limit]}
 
     # ---- autoscaler --------------------------------------------------------
 

@@ -1,0 +1,296 @@
+"""Metrics: process-local registry + Prometheus text exposition.
+
+Equivalent of the reference's stats layer
+(reference: src/ray/stats/metric.h:102 — OpenCensus measures exported
+through the node metrics agent to Prometheus endpoints;
+src/ray/stats/metric_defs.cc for the core metric set;
+python/ray/util/metrics.py for the user-facing API).
+
+Design: every process owns one MetricsRegistry.  Daemons (head, node
+agent) expose theirs over a minimal HTTP endpoint (`GET /metrics`);
+workers push periodic snapshots to their node agent, which re-exports
+them with worker labels — one scrape target per node, like the
+reference's reporter agent (dashboard/modules/reporter/reporter_agent.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import bisect
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _labelkey(tags: Optional[Dict[str, str]]) -> _LabelKey:
+    return tuple(sorted((tags or {}).items()))
+
+
+def _fmt_labels(key: _LabelKey) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 registry: Optional["MetricsRegistry"] = None):
+        self.name = name
+        self.description = description
+        self._lock = threading.Lock()
+        self._registry = registry or default_registry
+        self._registry.register(self)
+
+    def _tags(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        base = self._registry.default_tags
+        return {**base, **(tags or {})} if base else (tags or {})
+
+    def render(self) -> List[str]:
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, description="", registry=None):
+        super().__init__(name, description, registry)
+        self._values: Dict[_LabelKey, float] = {}
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        key = _labelkey(self._tags(tags))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def render(self) -> List[str]:
+        with self._lock:
+            items = list(self._values.items())
+        out = [f"# HELP {self.name} {self.description}",
+               f"# TYPE {self.name} counter"]
+        for key, v in items or [((), 0.0)]:
+            out.append(f"{self.name}{_fmt_labels(key)} {v}")
+        return out
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, description="", registry=None):
+        super().__init__(name, description, registry)
+        self._values: Dict[_LabelKey, float] = {}
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[_labelkey(self._tags(tags))] = float(value)
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        key = _labelkey(self._tags(tags))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def dec(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        self.inc(-value, tags)
+
+    def render(self) -> List[str]:
+        with self._lock:
+            items = list(self._values.items())
+        out = [f"# HELP {self.name} {self.description}",
+               f"# TYPE {self.name} gauge"]
+        for key, v in items or [((), 0.0)]:
+            out.append(f"{self.name}{_fmt_labels(key)} {v}")
+        return out
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    DEFAULT_BOUNDARIES = [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60]
+
+    def __init__(self, name, description="", boundaries=None, registry=None):
+        super().__init__(name, description, registry)
+        self.boundaries = list(boundaries or self.DEFAULT_BOUNDARIES)
+        self._counts: Dict[_LabelKey, List[int]] = {}
+        self._sums: Dict[_LabelKey, float] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = _labelkey(self._tags(tags))
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.boundaries) + 1))
+            counts[bisect.bisect_left(self.boundaries, value)] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def render(self) -> List[str]:
+        with self._lock:
+            items = [(k, list(c), self._sums.get(k, 0.0))
+                     for k, c in self._counts.items()]
+        out = [f"# HELP {self.name} {self.description}",
+               f"# TYPE {self.name} histogram"]
+        for key, counts, total in items:
+            cum = 0
+            for b, c in zip(self.boundaries, counts):
+                cum += c
+                lk = key + (("le", str(b)),)
+                out.append(f"{self.name}_bucket{_fmt_labels(lk)} {cum}")
+            cum += counts[-1]
+            lk = key + (("le", "+Inf"),)
+            out.append(f"{self.name}_bucket{_fmt_labels(lk)} {cum}")
+            out.append(f"{self.name}_count{_fmt_labels(key)} {cum}")
+            out.append(f"{self.name}_sum{_fmt_labels(key)} {total}")
+        return out
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+        # foreign snapshots re-exported verbatim (worker pushes)
+        self._foreign: Dict[str, Tuple[str, float]] = {}
+        self.foreign_ttl_s = 30.0
+        # merged into every sample's tags (e.g. worker_id) so pushed
+        # snapshots from many workers don't collide on one endpoint
+        self.default_tags: Dict[str, str] = {}
+        self._collectors: List[Any] = []  # callables run before render
+
+    def register(self, metric: _Metric) -> None:
+        with self._lock:
+            self._metrics[metric.name] = metric
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def add_collector(self, fn) -> None:
+        """fn() runs right before each render — the place to sample
+        gauges from live state (store occupancy, queue depths)."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def ingest_foreign(self, source: str, text: str) -> None:
+        """Store a pushed snapshot (e.g. from a worker) for re-export."""
+        with self._lock:
+            self._foreign[source] = (text, time.monotonic())
+
+    def render(self) -> str:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:
+                pass
+        with self._lock:
+            metrics = list(self._metrics.values())
+            now = time.monotonic()
+            self._foreign = {s: (t, ts) for s, (t, ts) in
+                             self._foreign.items()
+                             if now - ts < self.foreign_ttl_s}
+            foreign = [t for t, _ in self._foreign.values()]
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        for text in foreign:
+            lines.extend(text.splitlines())
+        return "\n".join(_merge_families(lines)) + "\n"
+
+    def has_samples(self) -> bool:
+        with self._lock:
+            return bool(self._metrics)
+
+
+def _merge_families(lines: List[str]) -> List[str]:
+    """Merge exposition lines from several sources into one valid text
+    exposition: exactly one HELP/TYPE header per metric family, with all
+    of a family's samples contiguous under it.  Needed because every
+    worker pushes a snapshot carrying its own headers — Prometheus
+    rejects duplicate TYPE lines and interleaved families."""
+    order: List[str] = []  # family names in first-seen order
+    families: Dict[str, Dict[str, Any]] = {}
+    suffix_of: Dict[str, str] = {}  # histogram child name -> family
+
+    def fam(name: str) -> Dict[str, Any]:
+        base = suffix_of.get(name, name)
+        f = families.get(base)
+        if f is None:
+            f = families[base] = {"help": None, "type": None, "samples": []}
+            order.append(base)
+        return f
+
+    for ln in lines:
+        if not ln:
+            continue
+        if ln.startswith("# "):
+            parts = ln.split(None, 3)
+            if len(parts) < 3:
+                continue
+            kind, name = parts[1], parts[2]
+            f = fam(name)
+            if kind == "HELP" and f["help"] is None:
+                f["help"] = ln
+            elif kind == "TYPE" and f["type"] is None:
+                f["type"] = ln
+                if len(parts) > 3 and parts[3].startswith("histogram"):
+                    for suffix in ("_bucket", "_count", "_sum"):
+                        suffix_of[name + suffix] = name
+            continue
+        name = ln.split("{", 1)[0].split(" ", 1)[0]
+        fam(name)["samples"].append(ln)
+
+    out: List[str] = []
+    for base in order:
+        f = families[base]
+        if f["help"]:
+            out.append(f["help"])
+        if f["type"]:
+            out.append(f["type"])
+        out.extend(f["samples"])
+    return out
+
+
+default_registry = MetricsRegistry()
+
+
+async def start_metrics_http_server(registry: MetricsRegistry,
+                                    host: str = "127.0.0.1",
+                                    port: int = 0) -> Tuple[asyncio.AbstractServer, int]:
+    """Minimal HTTP/1.0 exposition endpoint: `GET /metrics`.
+
+    Handcrafted on asyncio (no aiohttp in the image); Prometheus needs
+    nothing beyond status line + content-type + body."""
+
+    async def handle(reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter):
+        try:
+            request = await asyncio.wait_for(reader.readline(), timeout=10.0)
+            while True:  # drain headers
+                line = await asyncio.wait_for(reader.readline(), timeout=10.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            parts = request.decode("latin-1").split()
+            path = parts[1] if len(parts) >= 2 else "/"
+            if path.split("?")[0] in ("/metrics", "/"):
+                body = registry.render().encode()
+                status = b"200 OK"
+            else:
+                body = b"not found\n"
+                status = b"404 Not Found"
+            writer.write(b"HTTP/1.0 " + status +
+                         b"\r\nContent-Type: text/plain; version=0.0.4"
+                         b"\r\nContent-Length: " + str(len(body)).encode() +
+                         b"\r\n\r\n" + body)
+            await writer.drain()
+        except Exception:
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    server = await asyncio.start_server(handle, host, port)
+    bound = server.sockets[0].getsockname()[1]
+    return server, bound

@@ -125,9 +125,59 @@ class NodeAgent(RpcHost):
         self._apply_cluster_view(reply.get("cluster"), reply.get("version"))
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        await self._start_metrics(host)
         for _ in range(config.worker_pool_prestart_workers):
             self._spawn_worker()
         return self.port
+
+    async def _start_metrics(self, host: str) -> None:
+        """Per-node Prometheus endpoint: agent gauges + re-exported
+        worker snapshots (reference: reporter_agent.py — one scrape
+        target per node)."""
+        from ray_tpu._private.metrics import (Gauge, default_registry,
+                                              start_metrics_http_server)
+
+        default_registry.default_tags = {"node_id": self.node_id[:12]}
+        store_bytes = Gauge("rt_object_store_bytes", "plasma bytes in use")
+        store_objs = Gauge("rt_object_store_objects", "objects in plasma")
+        store_cap = Gauge("rt_object_store_capacity_bytes", "plasma capacity")
+        workers_g = Gauge("rt_worker_pool_size", "worker processes alive")
+        leases_g = Gauge("rt_leases_active", "granted worker leases")
+        queued_g = Gauge("rt_lease_queue_depth", "lease requests queued")
+
+        def collect():
+            try:
+                u = self.store.usage()
+                store_bytes.set(u.get("allocated", 0))
+                store_objs.set(u.get("num_objects", 0))
+                store_cap.set(u.get("capacity", 0))
+            except Exception:
+                pass
+            workers_g.set(len(self._workers))
+            leases_g.set(len(self._leases))
+            queued_g.set(len(self._lease_waiters))
+
+        default_registry.add_collector(collect)
+        try:
+            self._metrics_server, self.metrics_port = \
+                await start_metrics_http_server(default_registry, host)
+        except Exception:
+            self.metrics_port = 0
+
+    async def rpc_report_metrics(self, source: str, text: bytes):
+        """A worker pushes its rendered metrics snapshot for re-export."""
+        from ray_tpu._private.metrics import default_registry
+
+        default_registry.ingest_foreign(
+            source, text.decode() if isinstance(text, bytes) else text)
+
+    async def rpc_metrics_port(self):
+        return {"port": self.metrics_port}
+
+    async def rpc_list_objects(self, limit: int = 1000):
+        """Object summaries for the state API (reference:
+        node_manager.proto:405 GetObjectsInfo)."""
+        return {"objects": self.store.list_objects(limit)}
 
     async def stop(self):
         for t in self._tasks:
@@ -149,6 +199,8 @@ class NodeAgent(RpcHost):
             await self._head.close()
         for c in self._peers.values():
             await c.close()
+        if getattr(self, "_metrics_server", None) is not None:
+            self._metrics_server.close()
         if self._server:
             await self._server.stop()
         self.store.close(unlink=True)

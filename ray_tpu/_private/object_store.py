@@ -306,6 +306,18 @@ class StoreCore:
             "num_evicted": self.num_evicted,
         }
 
+    def list_objects(self, limit: int = 1000) -> List[Dict[str, Any]]:
+        """Object summaries for the state API (reference:
+        GetObjectsInfo in node_manager.proto:405)."""
+        out = []
+        for oid, e in self.objects.items():
+            out.append({"object_id": oid, "size": e.size,
+                        "location": e.location, "sealed": e.sealed,
+                        "primary": e.primary, "pins": sum(e.pins.values())})
+            if len(out) >= limit:
+                break
+        return out
+
     # ---- memory pressure -------------------------------------------------
 
     def _drop(self, oid: str, entry: _Entry) -> None:

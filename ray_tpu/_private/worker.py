@@ -210,12 +210,64 @@ class CoreWorker(RpcHost):
         self._put_counter = 0
         self._put_lock = threading.Lock()
         self._shutdown = False
+        # observability: task-event buffer flushed to the head in batches
+        # (reference: task_event_buffer.h:206) + process metrics pushed
+        # to the node agent for re-export on its Prometheus endpoint
+        self._task_events: List[Dict[str, Any]] = []
+        self._task_events_lock = threading.Lock()
+        self._io.spawn(self._observability_loop())
         # worker-mode execution state
         self._task_queue: "queue.Queue" = queue.Queue()
         self._actor_instance: Any = None
         self._actor_creation_spec: Optional[TaskSpec] = None
         self._pending_acks: Dict[str, Any] = {}  # task_id -> held values
         self._exec_threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------- observability
+
+    def record_task_event(self, task_id: str, state: str, **fields) -> None:
+        """Buffer a task state transition; flushed to the head in batches
+        (reference: task_event_buffer.h FlushEvents)."""
+        ev = {"task_id": task_id, "state": state,
+              "worker_id": self.worker_id, "node_id": self.node_id,
+              f"{state.lower()}_ts": time.time()}
+        sub = os.environ.get("RT_JOB_ID")
+        if sub:
+            # correlate this driver's tasks with its job submission id
+            ev["submission_id"] = sub
+        ev.update(fields)
+        with self._task_events_lock:
+            self._task_events.append(ev)
+            if len(self._task_events) > config.task_events_buffer_size:
+                del self._task_events[:len(self._task_events) // 2]
+
+    async def _observability_loop(self):
+        import asyncio
+
+        from ray_tpu._private.metrics import default_registry
+
+        default_registry.default_tags.setdefault(
+            "worker_id", self.worker_id[:12])
+        interval = max(0.2, config.metrics_report_interval_ms / 1000.0 / 5)
+        while not self._shutdown:
+            await asyncio.sleep(interval)
+            with self._task_events_lock:
+                batch, self._task_events = self._task_events, []
+            if batch:
+                try:
+                    await self.head.aio.oneway("task_events", events=batch)
+                except Exception:
+                    pass
+            try:
+                # push whenever this process has registered any metric —
+                # user metrics in a driver count too
+                if default_registry.has_samples():
+                    text = default_registry.render()
+                    await (await self._aclient_agent(self.agent_addr)).oneway(
+                        "report_metrics", source=self.worker_id,
+                        text=text.encode())
+            except Exception:
+                pass
 
     # ------------------------------------------------------------------ utils
 
@@ -249,6 +301,18 @@ class CoreWorker(RpcHost):
         return c
 
     def shutdown(self):
+        # flush buffered task events before tearing the IO plane down —
+        # a short-lived driver's SUBMITTED events live in the last
+        # interval of the observability loop
+        with self._task_events_lock:
+            batch, self._task_events = self._task_events, []
+        if batch:
+            try:
+                self._io.run(
+                    self.head.aio.oneway("task_events", events=batch),
+                    timeout=2.0)
+            except Exception:
+                pass
         self._shutdown = True
         try:
             self.plasma.close()
@@ -759,6 +823,10 @@ class CoreWorker(RpcHost):
         for oid in task.return_oids:
             self.memory.ensure(oid)
             refs.append(ObjectRef(oid, owner_addr=self.address))
+        self.record_task_event(
+            spec.task_id, "SUBMITTED",
+            name=name or function_id[:8], kind=NORMAL_TASK,
+            job_id=self.job_id)
         self._spawn(self._submit(task))
         return refs
 
@@ -1325,14 +1393,37 @@ class CoreWorker(RpcHost):
             t.start()
             self._exec_threads.append(t)
 
+    _metrics = None
+
+    @classmethod
+    def _get_metrics(cls):
+        if cls._metrics is None:
+            from ray_tpu._private.metrics import Counter, Histogram
+
+            cls._metrics = {
+                "finished": Counter("rt_tasks_finished",
+                                    "tasks executed successfully"),
+                "failed": Counter("rt_tasks_failed", "tasks that raised"),
+                "duration": Histogram("rt_task_duration_seconds",
+                                      "task execution wall time"),
+            }
+        return cls._metrics
+
     def _execute(self, spec_wire: Dict[str, Any]) -> Dict[str, Any]:
         spec = TaskSpec.from_wire(spec_wire)
         self._exec.task_id = spec.task_id
         self._exec.job_id = spec.job_id
         self._exec.num_returns = spec.num_returns
+        m = self._get_metrics()
+        t0 = time.time()
+        self.record_task_event(spec.task_id, "RUNNING", name=spec.name
+                               or spec.method_name or spec.function_id[:8],
+                               kind=spec.kind, job_id=spec.job_id)
         try:
             args, kwargs, arg_ref_oids = self._materialize_args(spec)
         except BaseException as e:
+            m["failed"].inc()
+            self.record_task_event(spec.task_id, "FAILED", error=str(e)[:200])
             return self._error_reply(spec, e, traceback.format_exc())
         try:
             if spec.kind == ACTOR_CREATION_TASK:
@@ -1341,6 +1432,7 @@ class CoreWorker(RpcHost):
                 self._actor_creation_spec = spec
                 if spec.max_concurrency > 1 and not self._exec_threads:
                     self._start_concurrency_threads(spec.max_concurrency - 1)
+                self.record_task_event(spec.task_id, "FINISHED")
                 return {"results": []}
             if spec.kind == ACTOR_TASK:
                 if self._actor_instance is None:
@@ -1351,7 +1443,13 @@ class CoreWorker(RpcHost):
                 fn = self.functions.fetch(spec.function_id)
                 value = fn(*args, **kwargs)
         except BaseException as e:
+            m["failed"].inc()
+            m["duration"].observe(time.time() - t0)
+            self.record_task_event(spec.task_id, "FAILED", error=str(e)[:200])
             return self._error_reply(spec, e, traceback.format_exc())
+        m["finished"].inc()
+        m["duration"].observe(time.time() - t0)
+        self.record_task_event(spec.task_id, "FINISHED")
         return self._success_reply(spec, value, arg_ref_oids)
 
     def _materialize_args(self, spec: TaskSpec):

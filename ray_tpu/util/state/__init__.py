@@ -1,0 +1,4 @@
+from ray_tpu.util.state.api import (get_log, list_actors,  # noqa: F401
+                                    list_nodes, list_objects,
+                                    list_placement_groups, list_tasks,
+                                    summarize_tasks, timeline)

@@ -1,0 +1,113 @@
+"""State API: cluster introspection for humans and tools.
+
+Equivalent of the reference's state API
+(reference: python/ray/util/state/api.py — list_tasks/list_actors/
+list_objects/list_nodes backed by the state head aggregating GCS
+tables and per-raylet GetTasksInfo/GetObjectsInfo;
+src/ray/gcs/gcs_server/gcs_task_manager.h for the task-event store).
+`timeline()` renders the task-event store as a Chrome trace, the
+equivalent of `ray.timeline()`.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+
+def _head():
+    import ray_tpu
+
+    return ray_tpu.api._worker().head
+
+
+def list_tasks(state: str = "", name: str = "",
+               limit: int = 1000) -> List[Dict[str, Any]]:
+    """Task records merged from worker-flushed state transitions.
+    Filters: state in SUBMITTED/RUNNING/FINISHED/FAILED, task name."""
+    return _head().call("list_tasks", state=state, name=name,
+                        limit=limit)["tasks"]
+
+
+def list_actors() -> List[Dict[str, Any]]:
+    return _head().call("list_actors")["actors"]
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    table = _head().call("node_table")
+    return list(table.values())
+
+
+def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Plasma object summaries aggregated across every node's store."""
+    return _head().call("list_objects", limit=limit)["objects"]
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    return _head().call("list_placement_groups")["placement_groups"]
+
+
+def summarize_tasks() -> Dict[str, Dict[str, int]]:
+    """Counts by task name and state (reference: `ray summary tasks`)."""
+    out: Dict[str, Dict[str, int]] = {}
+    for t in list_tasks(limit=100_000):
+        name = t.get("name", "?")
+        state = t.get("state", "?")
+        row = out.setdefault(name, {})
+        row[state] = row.get(state, 0) + 1
+    return out
+
+
+def timeline(path: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Chrome-trace events (chrome://tracing / perfetto) from the task
+    event store (reference: ray.timeline(), task profile events).
+    Returns the event list; writes JSON to `path` if given."""
+    events: List[Dict[str, Any]] = []
+    for t in list_tasks(limit=100_000):
+        start = t.get("running_ts")
+        end = t.get("finished_ts") or t.get("failed_ts")
+        if start is None or end is None:
+            continue
+        events.append({
+            "name": t.get("name", t["task_id"][:8]),
+            "cat": t.get("kind", "task"),
+            "ph": "X",
+            "ts": int(start * 1e6),
+            "dur": max(1, int((end - start) * 1e6)),
+            "pid": t.get("node_id", "")[:8],
+            "tid": t.get("worker_id", "")[:8],
+            "args": {"task_id": t["task_id"], "state": t.get("state")},
+        })
+    if path:
+        with open(path, "w") as f:
+            json.dump(events, f)
+    return events
+
+
+def get_log(node_id: str = "", filename: str = "",
+            tail: int = 1000) -> str:
+    """Read a daemon/worker log from the session directory
+    (reference: ray.util.state.get_log)."""
+    import glob
+    import os
+
+    import ray_tpu
+
+    w = ray_tpu.api._worker()
+    session = getattr(w, "session_dir", None)
+    if session is None:
+        base = os.environ.get("RT_TMPDIR", "/tmp/ray_tpu")
+        sessions = sorted(glob.glob(os.path.join(base, "session_*")))
+        if not sessions:
+            return ""
+        session = sessions[-1]
+    logs = os.path.join(session, "logs")
+    target = os.path.join(logs, filename) if filename else None
+    if target is None or not os.path.exists(target):
+        candidates = sorted(glob.glob(os.path.join(logs, "*.log")))
+        if not candidates:
+            return ""
+        target = candidates[-1]
+    with open(target, errors="replace") as f:
+        lines = f.readlines()
+    return "".join(lines[-tail:])

@@ -1,0 +1,173 @@
+"""Metrics + state API + task events + timeline tests.
+
+Mirrors the reference's observability suites
+(reference: python/ray/tests/test_metrics_agent.py,
+test_state_api.py; stats plane src/ray/stats/metric.h, task events
+src/ray/core_worker/task_event_buffer.h:206)."""
+
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    try:
+        yield ray_tpu
+    finally:
+        ray_tpu.shutdown()
+
+
+def _scrape(port: int) -> str:
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=10) as r:
+        return r.read().decode()
+
+
+def _agent_metrics_port() -> int:
+    w = ray_tpu.api._worker()
+    return w.agent.call("metrics_port")["port"]
+
+
+def _head_metrics_port() -> int:
+    w = ray_tpu.api._worker()
+    return w.head.call("metrics_port")["port"]
+
+
+def test_agent_prometheus_endpoint(cluster):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1), timeout=60) == 2
+    port = _agent_metrics_port()
+    assert port > 0
+    text = _scrape(port)
+    assert "rt_object_store_capacity_bytes" in text
+    assert "rt_worker_pool_size" in text
+    # the worker that executed f pushes its counters for re-export
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        text = _scrape(port)
+        if "rt_tasks_finished" in text:
+            return
+        time.sleep(0.5)
+    raise AssertionError("worker metrics never re-exported:\n" + text[:800])
+
+
+def test_head_prometheus_endpoint(cluster):
+    port = _head_metrics_port()
+    assert port > 0
+    text = _scrape(port)
+    assert "rt_head_nodes" in text
+    assert "rt_head_nodes 1.0" in text or "rt_head_nodes 1 " in text \
+        or "rt_head_nodes 1\n" in text
+
+
+def test_user_metrics_exported(cluster):
+    from ray_tpu.util.metrics import Counter
+
+    @ray_tpu.remote
+    def instrumented():
+        c = Counter("my_app_events", "app-level counter")
+        c.inc(3)
+        return "ok"
+
+    assert ray_tpu.get(instrumented.remote(), timeout=60) == "ok"
+    port = _agent_metrics_port()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if "my_app_events" in _scrape(port):
+            return
+        time.sleep(0.5)
+    raise AssertionError("user metric never appeared on the node endpoint")
+
+
+def test_list_tasks_and_summary(cluster):
+    from ray_tpu.util.state import list_tasks, summarize_tasks
+
+    @ray_tpu.remote
+    def traced(x):
+        return x
+
+    ray_tpu.get([traced.remote(i) for i in range(5)], timeout=60)
+    # NB: tasks defined inside a test function carry their qualname
+    # ("test_x.<locals>.traced") — filter by suffix
+    deadline = time.monotonic() + 15
+    finished = []
+    while time.monotonic() < deadline:
+        finished = [t for t in list_tasks()
+                    if t.get("name", "").endswith("traced")
+                    and t.get("state") == "FINISHED"]
+        if len(finished) >= 5:
+            break
+        time.sleep(0.3)
+    assert len(finished) >= 5, finished
+    t = finished[0]
+    assert t["worker_id"] and t["node_id"]
+    assert t.get("running_ts") and t.get("finished_ts")
+    summary = summarize_tasks()
+    traced_rows = [v for k, v in summary.items() if k.endswith("traced")]
+    assert traced_rows and traced_rows[0].get("FINISHED", 0) >= 5
+
+
+def test_failed_task_recorded(cluster):
+    from ray_tpu.util.state import list_tasks
+
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("kaput")
+
+    with pytest.raises(ray_tpu.RayError):
+        ray_tpu.get(boom.remote(), timeout=60)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        failed = [t for t in list_tasks(state="FAILED")
+                  if t.get("name", "").endswith("boom")]
+        if failed:
+            assert "kaput" in failed[0].get("error", "")
+            return
+        time.sleep(0.3)
+    raise AssertionError("failed task never recorded")
+
+
+def test_timeline_chrome_trace(cluster, tmp_path):
+    import json
+
+    from ray_tpu.util.state import timeline
+
+    @ray_tpu.remote
+    def span():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([span.remote() for _ in range(3)], timeout=60)
+    path = str(tmp_path / "trace.json")
+    deadline = time.monotonic() + 15
+    events = []
+    while time.monotonic() < deadline:
+        events = [e for e in timeline(path)
+                  if e["name"].endswith("span")]
+        if len(events) >= 3:
+            break
+        time.sleep(0.3)
+    assert len(events) >= 3
+    ev = events[0]
+    assert ev["ph"] == "X" and ev["dur"] >= 50_000  # >=50ms in usecs
+    assert json.load(open(path))  # valid JSON on disk
+
+
+def test_list_objects(cluster):
+    import numpy as np
+
+    from ray_tpu.util.state import list_objects
+
+    ref = ray_tpu.put(np.zeros(300_000))  # ~2.4MB -> plasma
+    objs = list_objects()
+    assert any(o["object_id"] == ref.oid for o in objs), objs
+    assert all("size" in o and "node_id" in o for o in objs)
+    del ref
