@@ -46,11 +46,20 @@ def init(address: Optional[str] = None, *,
     (reference: worker.py:1217 bootstrap path). With address="host:port",
     connects to an existing head and uses the head node's agent.
     """
+    import os as _os
+
     from ray_tpu._private import node as node_mod
     from ray_tpu._private.config import config
     from ray_tpu._private.rpc import EventLoopThread, SyncRpcClient
     from ray_tpu._private.worker import CoreWorker, MODE_DRIVER, \
         global_worker_or_none, set_global_worker
+
+    if address is None:
+        # the environment wins when a job/driver was launched by the CLI
+        # or job supervisor (reference: RAY_ADDRESS)
+        address = _os.environ.get("RT_ADDRESS") or None
+    if address == "local":
+        address = None
 
     global _global_node
     with _state_lock:

@@ -1,0 +1,293 @@
+"""CLI: stand clusters up and operate them from the shell.
+
+Equivalent of the reference's `ray` CLI
+(reference: python/ray/scripts/scripts.py — start :568, stop :1044,
+status; job CLI in dashboard/modules/job/cli.py; summary/state CLI in
+python/ray/util/state/state_cli.py).  Installed as `rtpu` via
+[project.scripts].
+
+  rtpu start --head [--port N] [--num-cpus N] [--resources JSON]
+  rtpu start --address HOST:PORT [--num-cpus N]     # join as a worker node
+  rtpu status [--address HOST:PORT]
+  rtpu stop   [--address HOST:PORT]
+  rtpu job submit [--address A] [--working-dir D] -- python train.py
+  rtpu job status|logs|stop JOB_ID
+  rtpu job list
+  rtpu summary tasks|actors|objects
+  rtpu timeline -o trace.json
+
+Cluster discovery: `start --head` records the address in
+$RT_TMPDIR/latest_cluster.json; other commands use --address,
+RT_ADDRESS, or that file, in that order.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Optional, Tuple
+
+
+def _registry_path() -> str:
+    base = os.environ.get("RT_TMPDIR", "/tmp/ray_tpu")
+    os.makedirs(base, exist_ok=True)
+    return os.path.join(base, "latest_cluster.json")
+
+
+def _resolve_address(explicit: Optional[str]) -> Tuple[str, int]:
+    addr = explicit or os.environ.get("RT_ADDRESS")
+    if not addr:
+        try:
+            with open(_registry_path()) as f:
+                addr = json.load(f)["address"]
+        except Exception:
+            raise SystemExit(
+                "no cluster address: pass --address, set RT_ADDRESS, or "
+                "run `rtpu start --head` on this machine first")
+    host, port_s = addr.rsplit(":", 1)
+    return host, int(port_s)
+
+
+def _head_client(addr: Tuple[str, int]):
+    from ray_tpu._private.rpc import EventLoopThread, SyncRpcClient
+
+    io = EventLoopThread(name="rtpu-cli")
+    return SyncRpcClient(addr[0], addr[1], io, label="head"), io
+
+
+# ---------------------------------------------------------------- start/stop
+
+
+def cmd_start(args) -> int:
+    from ray_tpu._private import node as node_mod
+
+    if not args.head and not args.address:
+        print("pass --head to start a cluster or --address to join one",
+              file=sys.stderr)
+        return 2
+    session_dir = node_mod.new_session_dir()
+    if args.head:
+        head_proc, head_addr = node_mod.start_head(session_dir,
+                                                   port=args.port)
+        res = node_mod.default_resources(args.num_cpus,
+                                         json.loads(args.resources))
+        agent_proc, info = node_mod.start_node_agent(
+            session_dir, head_addr, res,
+            object_store_memory=args.object_store_memory,
+            is_head_node=True)
+        address = f"{head_addr[0]}:{head_addr[1]}"
+        with open(_registry_path(), "w") as f:
+            json.dump({"address": address, "session_dir": session_dir,
+                       "head_pid": head_proc.proc.pid,
+                       "agent_pids": [agent_proc.proc.pid]}, f)
+        print(f"cluster started at {address}")
+        print(f"session dir: {session_dir}")
+        print(f"connect with ray_tpu.init(address=\"{address}\") "
+              f"or RT_ADDRESS={address}")
+    else:
+        head_addr = _resolve_address(args.address)
+        res = node_mod.default_resources(args.num_cpus,
+                                         json.loads(args.resources))
+        _, info = node_mod.start_node_agent(
+            session_dir, head_addr, res,
+            object_store_memory=args.object_store_memory)
+        print(f"node {info['node_id'][:12]} joined "
+              f"{head_addr[0]}:{head_addr[1]}")
+    return 0
+
+
+def cmd_stop(args) -> int:
+    addr = _resolve_address(args.address)
+    head, io = _head_client(addr)
+    try:
+        head.call("shutdown_cluster", timeout=10)
+        print("cluster shutdown requested")
+    except Exception as e:
+        print(f"head unreachable ({e}); nothing to stop?", file=sys.stderr)
+        return 1
+    finally:
+        head.close()
+        io.stop()
+    try:
+        os.unlink(_registry_path())
+    except OSError:
+        pass
+    return 0
+
+
+def cmd_status(args) -> int:
+    addr = _resolve_address(args.address)
+    head, io = _head_client(addr)
+    try:
+        table = head.call("node_table", timeout=10)
+        res = head.call("cluster_resources", timeout=10)
+        auto = head.call("autoscaler_state", timeout=10)
+    finally:
+        head.close()
+        io.stop()
+    print(f"cluster at {addr[0]}:{addr[1]} — {len(table)} node(s)")
+    for nid, n in table.items():
+        r = n["resources"]
+        role = " (head)" if n.get("is_head_node") else ""
+        print(f"  {nid[:12]}{role}  total={r['total']}  "
+              f"available={r['available']}")
+    print(f"resources: total={res['total']} available={res['available']}")
+    pending = sum(len(n["pending"]) for n in auto["nodes"])
+    if pending or auto["pending_pg_bundles"] or auto["pending_actors"]:
+        print(f"pending demands: {pending} lease(s), "
+              f"{len(auto['pending_pg_bundles'])} pg bundle(s), "
+              f"{len(auto['pending_actors'])} actor(s)")
+    return 0
+
+
+# ---------------------------------------------------------------------- jobs
+
+
+def cmd_job(args) -> int:
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    addr = _resolve_address(args.address)
+    client = JobSubmissionClient(f"{addr[0]}:{addr[1]}")
+    try:
+        if args.job_cmd == "submit":
+            entrypoint = " ".join(args.entrypoint)
+            if not entrypoint:
+                print("nothing to run: rtpu job submit -- python x.py",
+                      file=sys.stderr)
+                return 2
+            job_id = client.submit_job(
+                entrypoint, working_dir=args.working_dir or None)
+            print(f"submitted {job_id}")
+            if args.wait:
+                status = client.wait_until_finish(job_id)
+                print(f"{job_id}: {status}")
+                sys.stdout.write(client.get_job_logs(job_id))
+                return 0 if status == "SUCCEEDED" else 1
+            return 0
+        if args.job_cmd == "status":
+            print(json.dumps(client.get_job_info(args.job_id), indent=2))
+            return 0
+        if args.job_cmd == "logs":
+            sys.stdout.write(client.get_job_logs(args.job_id))
+            return 0
+        if args.job_cmd == "stop":
+            client.stop_job(args.job_id)
+            print(f"stop requested for {args.job_id}")
+            return 0
+        if args.job_cmd == "list":
+            for info in client.list_jobs():
+                print(f"{info['job_id']}  {info['status']:<10} "
+                      f"{info.get('entrypoint', '')[:60]}")
+            return 0
+    finally:
+        client.close()
+    return 2
+
+
+# ----------------------------------------------------------- state/summary
+
+
+def cmd_summary(args) -> int:
+    import ray_tpu
+
+    addr = _resolve_address(args.address)
+    ray_tpu.init(address=f"{addr[0]}:{addr[1]}")
+    try:
+        from ray_tpu.util import state as state_api
+
+        if args.what == "tasks":
+            for name, states in state_api.summarize_tasks().items():
+                print(f"{name}: {states}")
+        elif args.what == "actors":
+            for a in state_api.list_actors():
+                print(f"{a['actor_id'][:12]}  {a['state']:<10} "
+                      f"{a.get('name', '')}")
+        elif args.what == "objects":
+            total = 0
+            for o in state_api.list_objects():
+                total += o["size"]
+                print(f"{o['object_id'][:16]}  {o['size']:>12}  "
+                      f"{o['location']}  node={o['node_id'][:12]}")
+            print(f"total bytes: {total}")
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    import ray_tpu
+
+    addr = _resolve_address(args.address)
+    ray_tpu.init(address=f"{addr[0]}:{addr[1]}")
+    try:
+        from ray_tpu.util.state import timeline
+
+        events = timeline(args.output)
+        print(f"wrote {len(events)} events to {args.output}")
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
+# ----------------------------------------------------------------- argparse
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="rtpu",
+                                 description="ray_tpu cluster CLI")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head or join a cluster")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", default="")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--resources", default="{}")
+    p.add_argument("--object-store-memory", type=int, default=None)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="shut the cluster down")
+    p.add_argument("--address", default="")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status", help="nodes, resources, pending demand")
+    p.add_argument("--address", default="")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("job", help="submit and manage jobs")
+    p.add_argument("--address", default="")
+    jsub = p.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("--working-dir", default="")
+    js.add_argument("--wait", action="store_true",
+                    help="block until the job finishes, stream its logs")
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                    help="-- command to run")
+    for name in ("status", "logs", "stop"):
+        jp = jsub.add_parser(name)
+        jp.add_argument("job_id")
+    jsub.add_parser("list")
+    p.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser("summary", help="task/actor/object summaries")
+    p.add_argument("what", choices=["tasks", "actors", "objects"])
+    p.add_argument("--address", default="")
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("timeline", help="export a Chrome trace")
+    p.add_argument("-o", "--output", default="timeline.json")
+    p.add_argument("--address", default="")
+    p.set_defaults(fn=cmd_timeline)
+
+    args = ap.parse_args(argv)
+    # strip a leading "--" from REMAINDER entrypoints
+    if getattr(args, "entrypoint", None) and args.entrypoint[0] == "--":
+        args.entrypoint = args.entrypoint[1:]
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
