@@ -150,7 +150,8 @@ class _SchedState:
 
 class _ActorState:
     __slots__ = ("actor_id", "addr", "instance", "pending", "inflight",
-                 "pumping", "recovering", "dead", "death_cause", "seq")
+                 "pumping", "recovering", "dead", "death_cause", "seq",
+                 "resolving")
 
     def __init__(self, actor_id: str):
         self.actor_id = actor_id
@@ -163,6 +164,7 @@ class _ActorState:
         self.dead = False
         self.death_cause = ""
         self.seq = 0
+        self.resolving = None  # in-flight resolve future (coalesced)
 
 
 class CoreWorker(RpcHost):
@@ -1260,8 +1262,24 @@ class CoreWorker(RpcHost):
     async def _actor_pump(self, astate: _ActorState):
         if astate.recovering or astate.dead:
             return
-        if astate.addr is None:
-            await self._actor_resolve(astate)
+        while astate.addr is None:
+            # keep long-polling until the actor lands somewhere: slow
+            # constructors (first jax import in a fresh worker) can
+            # outlast one poll window, and pushing with addr=None would
+            # misclassify every queued task as a worker death.  One
+            # coroutine polls per actor; concurrent pumps await it
+            # instead of multiplying head long-polls.
+            import asyncio
+
+            if astate.resolving is not None:
+                await astate.resolving
+            else:
+                astate.resolving = asyncio.get_running_loop().create_future()
+                try:
+                    await self._actor_resolve(astate)
+                finally:
+                    fut, astate.resolving = astate.resolving, None
+                    fut.set_result(None)
             if astate.dead or astate.recovering:
                 return
         while astate.pending and astate.pending[0].deps_ready \
@@ -1300,16 +1318,43 @@ class CoreWorker(RpcHost):
             self._fail_task(astate.pending.popleft(), err)
 
     async def _actor_push(self, astate: _ActorState, task: _TaskState, instance: int):
+        addr = astate.addr
+        if addr is None:
+            # a concurrent recovery cleared the address between pump and
+            # push: this task was never sent — requeue it for free (it
+            # must NOT be charged a retry or misreported as a death)
+            astate.inflight.pop(task.spec.seqno, None)
+            self._actor_requeue(astate, task)
+            await self._actor_pump(astate)
+            return
         try:
-            c = await self._aclient_worker(astate.addr)
+            c = await self._aclient_worker(addr)
             reply = await c.call("push_task", spec=task.spec.to_wire(),
                                  timeout=_TASK_PUSH_TIMEOUT)
         except (ConnectionLost, Exception) as e:
             await self._actor_recover(astate, task, instance, e)
             return
-        await self._process_reply(task, reply, astate.addr)
+        # the snapshot, NOT astate.addr: a concurrent recovery may have
+        # cleared/re-pointed the live field while we awaited the reply,
+        # and borrows/acks must go to the worker that actually executed
+        await self._process_reply(task, reply, addr)
         astate.inflight.pop(task.spec.seqno, None)
         await self._actor_pump(astate)
+
+    def _actor_requeue(self, astate: _ActorState, task: _TaskState) -> None:
+        """Requeue preserving seqno order: concurrent pushes may requeue
+        out of pop order, and the worker executes in arrival order.
+        A task requeued after the actor died would sit in the dead
+        actor's deque forever (pump no-ops on dead), pinning its arg
+        refs — fail it instead."""
+        if astate.dead:
+            self._fail_task(task, ActorDiedError(
+                astate.death_cause or "actor is dead"))
+            return
+        astate.pending.append(task)
+        if len(astate.pending) > 1:
+            astate.pending = deque(
+                sorted(astate.pending, key=lambda t: t.spec.seqno))
 
     async def _actor_recover(self, astate: _ActorState, task: _TaskState,
                              instance: int, error: Exception):
@@ -1318,8 +1363,8 @@ class CoreWorker(RpcHost):
         if task.retries_left != 0:
             if task.retries_left > 0:
                 task.retries_left -= 1
-            # retryable: goes back to the front, re-sent after re-resolve
-            astate.pending.appendleft(task)
+            # retryable: requeued, re-sent after re-resolve
+            self._actor_requeue(astate, task)
         else:
             self._fail_task(task, ActorDiedError(
                 f"actor task {task.spec.method_name} failed: worker died ({error})"))

@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401

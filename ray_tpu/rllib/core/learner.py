@@ -1,0 +1,174 @@
+"""PPO Learner: the gradient-update half of the algorithm.
+
+Equivalent of the reference's Learner/LearnerGroup
+(reference: rllib/core/learner/learner.py, learner_group.py:64 —
+update_from_batch on local or remote GPU workers wrapped in DDP;
+PPO loss rllib/algorithms/ppo/torch/ppo_torch_learner.py).
+
+TPU-first redesign: ONE jitted update step does GAE, advantage
+normalization, and all SGD epochs x minibatches via lax.scan — no
+Python loop per minibatch, no host round-trips mid-update; params and
+optimizer state are donated so the update runs in place on device.
+Data parallelism over a mesh comes from sharding the batch dimension
+(parallel/mesh.py) — XLA inserts the gradient all-reduce, which is the
+GSPMD equivalent of the reference's DDP wrapper.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+
+class PPOLearner:
+    def __init__(self, module, *, lr: float = 3e-4, gamma: float = 0.99,
+                 gae_lambda: float = 0.95, clip_eps: float = 0.2,
+                 vf_coeff: float = 0.5, entropy_coeff: float = 0.01,
+                 num_epochs: int = 4, minibatch_size: int = 256,
+                 max_grad_norm: float = 0.5, seed: int = 0):
+        import jax
+        import optax
+
+        self.module = module
+        self.gamma = gamma
+        self.gae_lambda = gae_lambda
+        self.clip_eps = clip_eps
+        self.vf_coeff = vf_coeff
+        self.entropy_coeff = entropy_coeff
+        self.num_epochs = num_epochs
+        self.minibatch_size = minibatch_size
+        self.tx = optax.chain(optax.clip_by_global_norm(max_grad_norm),
+                              optax.adam(lr))
+        self.params = module.init(jax.random.PRNGKey(seed))
+        self.opt_state = self.tx.init(self.params)
+        self._rng = jax.random.PRNGKey(seed + 1)
+        self._update = None  # jitted lazily (first batch fixes shapes)
+
+    # ---- jitted update -----------------------------------------------------
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+
+        gamma, lam = self.gamma, self.gae_lambda
+        clip_eps = self.clip_eps
+        vf_c, ent_c = self.vf_coeff, self.entropy_coeff
+        module, tx = self.module, self.tx
+        num_epochs, mb = self.num_epochs, self.minibatch_size
+
+        def gae(rewards, values, last_value, nonterminal, mask):
+            """Reverse-scan GAE (compiler-friendly: lax.scan, no Python
+            loop over time)."""
+            next_values = jnp.concatenate(
+                [values[1:], last_value[None]], axis=0)
+
+            def step(carry, xs):
+                r, v, nv, nt, m = xs
+                delta = r + gamma * nv * nt - v
+                adv = delta + gamma * lam * nt * carry
+                adv = adv * m  # reset transitions carry nothing
+                return adv, adv
+
+            _, advs = jax.lax.scan(
+                step, jnp.zeros_like(last_value),
+                (rewards, values, next_values, nonterminal, mask),
+                reverse=True)
+            return advs
+
+        def loss_fn(params, b):
+            logits, values = module.apply(params, b["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, b["actions"][:, None], axis=-1)[:, 0]
+            ratio = jnp.exp(logp - b["logp_old"])
+            m = b["mask"]
+            msum = jnp.maximum(m.sum(), 1.0)
+            adv = b["adv"]
+            surr = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - clip_eps, 1 + clip_eps) * adv)
+            pi_loss = -(surr * m).sum() / msum
+            vf_loss = (jnp.square(values - b["v_target"]) * m).sum() / msum
+            entropy = (-(jnp.exp(logp_all) * logp_all).sum(-1) * m).sum() / msum
+            total = pi_loss + vf_c * vf_loss - ent_c * entropy
+            return total, (pi_loss, vf_loss, entropy)
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def update(params, opt_state, rng, batch):
+            # ---- flatten [T, E] -> [N] and compute targets once
+            T, E = batch["rewards"].shape
+            adv = gae(batch["rewards"], batch["values"],
+                      batch["last_value"], batch["nonterminal"],
+                      batch["mask"])
+            v_target = adv + batch["values"]
+            flat = {
+                "obs": batch["obs"].reshape(T * E, -1),
+                "actions": batch["actions"].reshape(T * E),
+                "logp_old": batch["logp"].reshape(T * E),
+                "adv": adv.reshape(T * E),
+                "v_target": v_target.reshape(T * E),
+                "mask": batch["mask"].reshape(T * E),
+            }
+            # normalize advantages over valid transitions
+            m = flat["mask"]
+            msum = jnp.maximum(m.sum(), 1.0)
+            mean = (flat["adv"] * m).sum() / msum
+            var = (jnp.square(flat["adv"] - mean) * m).sum() / msum
+            flat["adv"] = (flat["adv"] - mean) / jnp.sqrt(var + 1e-8)
+
+            N = T * E
+            mb_eff = min(mb, N)  # small rollouts: one minibatch = all
+            n_mb = max(1, N // mb_eff)
+            usable = n_mb * mb_eff
+
+            def epoch(carry, rng_e):
+                params, opt_state = carry
+                perm = jax.random.permutation(rng_e, N)[:usable]
+                mbs = jax.tree_util.tree_map(
+                    lambda x: x[perm].reshape(
+                        (n_mb, mb_eff) + x.shape[1:]), flat)
+
+                def mb_step(carry, mb_batch):
+                    params, opt_state = carry
+                    (loss, aux), grads = grad_fn(params, mb_batch)
+                    updates, opt_state = tx.update(grads, opt_state, params)
+                    import optax
+
+                    params = optax.apply_updates(params, updates)
+                    return (params, opt_state), (loss, *aux)
+
+                (params, opt_state), stats = jax.lax.scan(
+                    mb_step, (params, opt_state), mbs)
+                return (params, opt_state), stats
+
+            rngs = jax.random.split(rng, num_epochs)
+            (params, opt_state), stats = jax.lax.scan(
+                epoch, (params, opt_state), rngs)
+            losses = jax.tree_util.tree_map(lambda s: s.mean(), stats)
+            return params, opt_state, {
+                "total_loss": losses[0], "policy_loss": losses[1],
+                "vf_loss": losses[2], "entropy": losses[3]}
+
+        return update
+
+    def update_from_batch(self, batch: Dict[str, Any]) -> Dict[str, float]:
+        """One PPO update over a [T, E] rollout batch.  Returns stats."""
+        import jax
+
+        if self._update is None:
+            self._update = self._build_update()
+        self._rng, rng = jax.random.split(self._rng)
+        self.params, self.opt_state, stats = self._update(
+            self.params, self.opt_state, rng, batch)
+        return {k: float(v) for k, v in stats.items()}
+
+    # ---- weight transport --------------------------------------------------
+
+    def get_weights(self):
+        """Params as a numpy pytree (plasma-friendly)."""
+        import jax
+
+        return jax.tree_util.tree_map(lambda x: __import__("numpy").asarray(x),
+                                      self.params)

@@ -1,0 +1,80 @@
+"""RLModule: the framework-native policy/value network abstraction.
+
+Equivalent of the reference's RLModule
+(reference: rllib/core/rl_module/rl_module.py:867 —
+forward_inference / forward_exploration / forward_train as the three
+entry points), reduced to a JAX/flax actor-critic for the PPO slice.
+TPU-first: pure-functional apply (params are pytrees shipped through
+the object store), jit-friendly static shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+
+def _flax():
+    import flax.linen as nn
+
+    return nn
+
+
+class ActorCriticModule:
+    """Discrete-action actor-critic MLP (reference: rllib's default
+    fcnet Catalog models, models/catalog.py)."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden: Sequence[int] = (64, 64)):
+        import flax.linen as nn
+
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+
+        class _Net(nn.Module):
+            # separate actor/critic towers: a shared trunk lets the
+            # high-magnitude value loss thrash the policy features
+            # (reference: rllib vf_share_layers=False default for PPO)
+            hidden: Tuple[int, ...]
+            num_actions: int
+
+            @nn.compact
+            def __call__(self, obs):
+                x = obs
+                for h in self.hidden:
+                    x = nn.tanh(nn.Dense(h)(x))
+                logits = nn.Dense(self.num_actions,
+                                  kernel_init=nn.initializers.orthogonal(0.01)
+                                  )(x)
+                y = obs
+                for h in self.hidden:
+                    y = nn.tanh(nn.Dense(h)(y))
+                v = nn.Dense(1, kernel_init=nn.initializers.orthogonal(1.0))(y)
+                return logits, v[..., 0]
+
+        self.net = _Net(tuple(hidden), num_actions)
+
+    def init(self, rng) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        return self.net.init(rng, jnp.zeros((1, self.obs_dim)))
+
+    def apply(self, params, obs):
+        """-> (logits, value). Pure function: safe under jit/grad."""
+        return self.net.apply(params, obs)
+
+    def forward_inference(self, params, obs):
+        """Greedy action (reference: forward_inference)."""
+        import jax.numpy as jnp
+
+        logits, _ = self.apply(params, obs)
+        return jnp.argmax(logits, axis=-1)
+
+    def forward_exploration(self, params, obs, rng):
+        """Sampled action + logp + value (reference: forward_exploration)."""
+        import jax
+
+        logits, value = self.apply(params, obs)
+        action = jax.random.categorical(rng, logits)
+        logp = jax.nn.log_softmax(logits)[
+            jax.numpy.arange(logits.shape[0]), action]
+        return action, logp, value

@@ -1,0 +1,141 @@
+"""EnvRunner: vectorized environment sampling actor.
+
+Equivalent of the reference's EnvRunner
+(reference: rllib/env/single_agent_env_runner.py — gymnasium vector
+envs stepped with the current module weights, returning episodes to
+the algorithm).  Runs as a ray_tpu actor; rollout arrays ride the
+object store back to the learner.
+
+Gymnasium >= 1.0 vector autoreset is NextStep mode: the step after a
+terminal one resets that sub-env (its transition is a reset, not a
+real step) — those transitions are masked out of the loss instead of
+being special-cased.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class EnvRunner:
+    def __init__(self, env_name: str, num_envs: int, module_config: Dict[str, Any],
+                 seed: int = 0):
+        import gymnasium as gym
+        import jax
+        import numpy as np
+
+        from ray_tpu.rllib.core.rl_module import ActorCriticModule
+
+        self.envs = gym.make_vec(env_name, num_envs=num_envs)
+        self.num_envs = num_envs
+        self.module = ActorCriticModule(**module_config)
+        self._rng = jax.random.PRNGKey(seed)
+        self._np = np
+        obs, _ = self.envs.reset(seed=seed)
+        self._obs = np.asarray(obs, dtype=np.float32)
+        # envs needing a reset-step next (NextStep autoreset bookkeeping)
+        self._autoreset = np.zeros(num_envs, dtype=bool)
+        self._episode_return = np.zeros(num_envs, dtype=np.float64)
+        self._episode_len = np.zeros(num_envs, dtype=np.int64)
+        self._completed: list = []  # finished episode returns
+        self._policy = None
+
+    def _policy_fn(self):
+        if self._policy is None:
+            import jax
+
+            self._policy = jax.jit(self.module.forward_exploration)
+        return self._policy
+
+    def sample(self, weights, num_steps: int) -> Dict[str, Any]:
+        """Collect a [T, E] rollout with the given weights.
+
+        Returns numpy arrays: obs/actions/logp/values/rewards/
+        nonterminal/mask + last_value, plus episode stats.
+        """
+        import jax
+        import numpy as np
+
+        policy = self._policy_fn()
+        T, E = num_steps, self.num_envs
+        out = {
+            "obs": np.zeros((T, E) + self._obs.shape[1:], np.float32),
+            "actions": np.zeros((T, E), np.int32),
+            "logp": np.zeros((T, E), np.float32),
+            "values": np.zeros((T, E), np.float32),
+            "rewards": np.zeros((T, E), np.float32),
+            "nonterminal": np.ones((T, E), np.float32),
+            "mask": np.ones((T, E), np.float32),
+        }
+        self._completed = []
+        for t in range(T):
+            self._rng, step_rng = jax.random.split(self._rng)
+            action, logp, value = policy(weights, self._obs, step_rng)
+            action = np.asarray(action)
+            out["obs"][t] = self._obs
+            out["actions"][t] = action
+            out["logp"][t] = np.asarray(logp)
+            out["values"][t] = np.asarray(value)
+            # transitions taken from an autoreset step carry no reward
+            # signal for the PREVIOUS episode: mask them out
+            out["mask"][t] = (~self._autoreset).astype(np.float32)
+            obs, reward, terminated, truncated, _ = self.envs.step(action)
+            reward = np.asarray(reward, np.float32)
+            terminated = np.asarray(terminated)
+            truncated = np.asarray(truncated)
+            out["rewards"][t] = reward * (~self._autoreset)
+            # value bootstrap stops at termination; truncation bootstraps
+            out["nonterminal"][t] = (~terminated).astype(np.float32)
+            live = ~self._autoreset
+            self._episode_return[live] += reward[live]
+            self._episode_len[live] += 1
+            done = (terminated | truncated) & live
+            for i in np.nonzero(done)[0]:
+                self._completed.append(
+                    (float(self._episode_return[i]),
+                     int(self._episode_len[i])))
+                self._episode_return[i] = 0.0
+                self._episode_len[i] = 0
+            self._autoreset = terminated | truncated
+            self._obs = np.asarray(obs, np.float32)
+        self._rng, v_rng = jax.random.split(self._rng)
+        _, _, last_value = policy(weights, self._obs, v_rng)
+        out["last_value"] = np.asarray(last_value)
+        out["episode_returns"] = [r for r, _ in self._completed]
+        out["episode_lens"] = [l for _, l in self._completed]
+        return out
+
+    def evaluate(self, weights, num_episodes: int = 5,
+                 max_steps: int = 1000) -> float:
+        """Mean greedy-policy return (reference: evaluation rollouts)."""
+        import gymnasium as gym
+        import jax
+        import numpy as np
+
+        env = self.envs
+        infer = jax.jit(self.module.forward_inference)
+        obs, _ = env.reset()
+        obs = np.asarray(obs, np.float32)
+        returns: list = []
+        ep_ret = np.zeros(self.num_envs)
+        autoreset = np.zeros(self.num_envs, dtype=bool)
+        steps = 0
+        while len(returns) < num_episodes and steps < max_steps:
+            action = np.asarray(infer(weights, obs))
+            obs, r, term, trunc, _ = env.step(action)
+            obs = np.asarray(obs, np.float32)
+            live = ~autoreset
+            ep_ret[live] += np.asarray(r)[live]
+            done = (np.asarray(term) | np.asarray(trunc)) & live
+            for i in np.nonzero(done)[0]:
+                returns.append(float(ep_ret[i]))
+                ep_ret[i] = 0.0
+            autoreset = np.asarray(term) | np.asarray(trunc)
+            steps += 1
+        # leftover state belongs to training sampling: reset cleanly
+        obs, _ = self.envs.reset()
+        self._obs = np.asarray(obs, np.float32)
+        self._autoreset[:] = False
+        self._episode_return[:] = 0.0
+        self._episode_len[:] = 0
+        return float(np.mean(returns)) if returns else 0.0

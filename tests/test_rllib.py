@@ -1,0 +1,122 @@
+"""RLlib PPO slice tests.
+
+Mirrors the reference's PPO learning tests
+(reference: rllib/algorithms/ppo/tests/test_ppo.py — config build,
+training_step mechanics, and learning CartPole;
+rllib/core/learner/tests for the update path)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    from tests.conftest import force_cpu_jax
+
+    force_cpu_jax()
+    ray_tpu.init(num_cpus=6, object_store_memory=256 * 1024 * 1024)
+    try:
+        yield ray_tpu
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_learner_update_shapes(cluster):
+    """One jitted update on a synthetic batch: finite losses, params move."""
+    import jax
+
+    from ray_tpu.rllib.core.learner import PPOLearner
+    from ray_tpu.rllib.core.rl_module import ActorCriticModule
+
+    module = ActorCriticModule(obs_dim=4, num_actions=2)
+    learner = PPOLearner(module, minibatch_size=64, num_epochs=2, seed=0)
+    T, E = 32, 4
+    rng = np.random.default_rng(0)
+    batch = {
+        "obs": rng.normal(size=(T, E, 4)).astype(np.float32),
+        "actions": rng.integers(0, 2, size=(T, E)).astype(np.int32),
+        "logp": np.full((T, E), -0.69, np.float32),
+        "values": np.zeros((T, E), np.float32),
+        "rewards": np.ones((T, E), np.float32),
+        "nonterminal": np.ones((T, E), np.float32),
+        "mask": np.ones((T, E), np.float32),
+        "last_value": np.zeros((E,), np.float32),
+    }
+    before = jax.tree_util.tree_leaves(learner.params)[0].copy()
+    stats = learner.update_from_batch(batch)
+    after = jax.tree_util.tree_leaves(learner.params)[0]
+    assert np.isfinite(stats["total_loss"])
+    assert not np.allclose(before, after), "update did not move params"
+
+
+def test_env_runner_rollout(cluster):
+    """EnvRunner actor returns a consistent [T, E] rollout."""
+    from ray_tpu.rllib.core.rl_module import ActorCriticModule
+    from ray_tpu.rllib.env_runner import EnvRunner
+
+    module_cfg = {"obs_dim": 4, "num_actions": 2}
+    runner = ray_tpu.remote(EnvRunner).remote("CartPole-v1", 4, module_cfg,
+                                              seed=0)
+    module = ActorCriticModule(**module_cfg)
+    import jax
+
+    weights = jax.tree_util.tree_map(
+        np.asarray, module.init(jax.random.PRNGKey(0)))
+    ro = ray_tpu.get(runner.sample.remote(weights, 64), timeout=300)
+    assert ro["obs"].shape == (64, 4, 4)
+    assert ro["actions"].shape == (64, 4)
+    assert ro["last_value"].shape == (4,)
+    # masked fraction is small (resets are rare relative to steps)
+    assert ro["mask"].mean() > 0.5
+    # with a random policy CartPole episodes finish within 64*4 steps
+    assert len(ro["episode_returns"]) > 0
+    ray_tpu.kill(runner)
+
+
+def test_ppo_learns_cartpole(cluster):
+    """North star: CartPole reward > 450 in CI minutes on CPU
+    (reference: rllib PPO CartPole tuned example)."""
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                         rollout_fragment_length=128)
+            .training(lr=2.5e-4, minibatch_size=128, num_epochs=4)
+            .debugging(seed=3)
+            .build())
+    try:
+        best = 0.0
+        for _ in range(150):
+            result = algo.train()
+            best = max(best, result["episode_return_mean"])
+            if best > 450:
+                break
+        assert best > 450, f"PPO only reached {best} return"
+        # the greedy policy holds the pole too
+        assert algo.evaluate(num_episodes=5) > 400
+    finally:
+        algo.stop()
+
+
+def test_ppo_under_tuner(cluster):
+    """PPO as a Tune trainable: metrics reported per iteration
+    (reference: Algorithm is a Trainable run through Tuner)."""
+    from ray_tpu.rllib import PPOConfig
+    from ray_tpu.tune import TuneConfig, Tuner
+
+    base = (PPOConfig()
+            .env_runners(num_env_runners=1, num_envs_per_env_runner=4,
+                         rollout_fragment_length=64)
+            .debugging(seed=0))
+    tuner = Tuner(
+        base.to_trainable(max_iterations=3),
+        param_space={"lr": 1e-3},
+        tune_config=TuneConfig(metric="episode_return_mean", mode="max",
+                               num_samples=1))
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.metrics.get("training_iteration", 0) >= 3
+    assert "episode_return_mean" in best.metrics
