@@ -80,6 +80,11 @@ class NodeAgent(RpcHost):
         self.capacity = capacity or config.object_store_memory_bytes
         spill_dir = os.path.join(session_dir, f"spill-{self.node_id[:12]}")
         self.store = StoreCore(self.arena_path, self.capacity, spill_dir)
+        # implicit per-node resource for node-affine placement (per-node
+        # serve proxies, node-pinned actors; reference: the "node:<ip>"
+        # implicit resource in common/scheduling)
+        resources = dict(resources)
+        resources.setdefault(f"node:{self.node_id[:12]}", 1.0)
         self.resources = NodeResources(ResourceSet(resources))
         self.local = LocalScheduler(self.resources)
         # placement-group bundles reserved on this node: "pgid:idx" ->

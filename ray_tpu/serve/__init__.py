@@ -10,8 +10,10 @@ into one jitted forward, the TPU-efficient serving shape.
 from ray_tpu.serve.api import (Application, Deployment, DeploymentHandle,
                                batch, delete, deployment, get_handle, run,
                                shutdown)
-from ray_tpu.serve.http import shutdown_http, start_http
+from ray_tpu.serve.http import (proxy_addresses, shutdown_http,
+                                start_http, start_per_node_http)
 
 __all__ = ["deployment", "run", "get_handle", "delete", "shutdown",
            "batch", "Deployment", "DeploymentHandle", "Application",
-           "start_http", "shutdown_http"]
+           "start_http", "start_per_node_http", "proxy_addresses",
+           "shutdown_http"]

@@ -235,15 +235,116 @@ class ServeController:
     deployment_state.py:1226, autoscaling_policy.py)."""
 
     RECONCILE_PERIOD_S = 0.5
+    CHECKPOINT_KEY = "serve:controller:checkpoint"
 
     def __init__(self):
         self.apps: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.Lock()
         self._version_counter = 0  # monotonic across redeploys
         self._stop = threading.Event()
+        self._recover_from_checkpoint()
+        self._sweep_orphan_replicas()  # even when no checkpoint exists
         self._loop_thread = threading.Thread(
             target=self._reconcile_loop, name="serve-reconcile", daemon=True)
         self._loop_thread.start()
+
+    # ---- fault tolerance ---------------------------------------------------
+    # The controller checkpoints desired state to the internal KV and
+    # reattaches its detached, named replicas on restart — killing the
+    # controller loses no deployments (reference: _private/controller.py
+    # checkpoints to the GCS KV; application_state recovers replica
+    # actors by name).
+
+    def _save_checkpoint(self) -> None:
+        import cloudpickle
+
+        from ray_tpu.experimental import internal_kv
+
+        with self._lock:
+            snap = {"version_counter": self._version_counter, "apps": {}}
+            for name, app in self.apps.items():
+                snap["apps"][name] = {
+                    "target_blob": app["target_blob"],
+                    "init_args": app["init_args"],
+                    "init_kwargs": app["init_kwargs"],
+                    "actor_options": app["actor_options"],
+                    "max_ongoing": app["max_ongoing"],
+                    "autoscaling": app["autoscaling"],
+                    "desired": app["desired"],
+                    "version": app["version"],
+                    "replica_names": list(app.get("replica_names", {}).values()),
+                }
+        try:
+            internal_kv.kv_put(self.CHECKPOINT_KEY, cloudpickle.dumps(snap))
+        except Exception:
+            pass  # head briefly unreachable: next mutation re-saves
+
+    def _recover_from_checkpoint(self) -> None:
+        import cloudpickle
+
+        import ray_tpu
+        from ray_tpu.experimental import internal_kv
+
+        try:
+            raw = internal_kv.kv_get(self.CHECKPOINT_KEY)
+        except Exception:
+            raw = None
+        if not raw:
+            return
+        try:
+            snap = cloudpickle.loads(raw)
+        except Exception:
+            return
+        self._version_counter = snap.get("version_counter", 0)
+        for name, spec in snap.get("apps", {}).items():
+            replicas = []
+            replica_names = {}
+            for rname in spec.get("replica_names", []):
+                try:
+                    h = ray_tpu.get_actor(rname)
+                    replicas.append(h)
+                    replica_names[h._actor_id] = rname
+                except Exception:
+                    continue  # replica died with the outage: healed below
+            self.apps[name] = {
+                "target_blob": spec["target_blob"],
+                "init_args": spec["init_args"],
+                "init_kwargs": spec["init_kwargs"],
+                "actor_options": spec["actor_options"],
+                "max_ongoing": spec["max_ongoing"],
+                "autoscaling": spec["autoscaling"],
+                "desired": spec["desired"],
+                "replicas": replicas,
+                "replica_names": replica_names,
+                "version": spec["version"],
+                "ongoing": {},
+            }
+
+    def _sweep_orphan_replicas(self) -> None:
+        """Kill live 'serve:*' replica actors no checkpoint references:
+        a controller that died mid-deploy (replicas are detached and
+        started BEFORE the post-health-check checkpoint) leaves them
+        running with no owner record."""
+        import ray_tpu
+
+        known = set()
+        with self._lock:
+            for app in self.apps.values():
+                known.update(app.get("replica_names", {}).values())
+        try:
+            actors = ray_tpu.api._worker().head.call("list_actors",
+                                                     timeout=10)["actors"]
+        except Exception:
+            return
+        for a in actors:
+            name = a.get("name", "")
+            if (name.startswith("serve:") and name not in known
+                    and a.get("state") in ("ALIVE", "PENDING", "RESTARTING")):
+                try:
+                    h = ray_tpu.get_actor(name)
+                    ray_tpu.kill(h)
+                except Exception:
+                    pass
 
     # ---- desired state -----------------------------------------------------
 
@@ -265,12 +366,14 @@ class ServeController:
             "autoscaling": autoscaling,
             "desired": num_replicas,
             "replicas": [],
+            "replica_names": {},  # actor_id -> detached actor name
             "version": 0,
             "ongoing": {},   # handle_id -> (reported count, timestamp)
         }
         # blue-green: bring the new replicas up FIRST; a failing redeploy
         # must not take down a working deployment
-        replicas = [self._start_replica(app) for _ in range(num_replicas)]
+        replicas = [self._start_replica(app, name)
+                    for _ in range(num_replicas)]
         try:
             # block until every replica's constructor finished (model loaded)
             ray_tpu.get([r.health.remote() for r in replicas], timeout=600)
@@ -293,16 +396,26 @@ class ServeController:
                     ray_tpu.kill(h)
                 except Exception:
                     pass
+        self._save_checkpoint()
         return True
 
-    def _start_replica(self, app):
+    def _start_replica(self, app, dep_name: str):
+        import uuid
+
         import ray_tpu
 
+        # detached + named: replicas survive a controller crash and are
+        # reattached from the checkpoint by name
+        rname = f"serve:{dep_name}:{uuid.uuid4().hex[:8]}"
         cls = ray_tpu.remote(_Replica).options(
             max_concurrency=max(2, app["max_ongoing"]),
+            name=rname, lifetime="detached",
             **app["actor_options"])
-        return cls.remote(app["target_blob"], app["init_args"],
-                          app["init_kwargs"])
+        h = cls.remote(app["target_blob"], app["init_args"],
+                       app["init_kwargs"])
+        with self._lock:  # _save_checkpoint iterates this under the lock
+            app["replica_names"][h._actor_id] = rname
+        return h
 
     # ---- reconciliation ----------------------------------------------------
 
@@ -317,6 +430,10 @@ class ServeController:
                     self._reconcile_one(ray_tpu, name, app)
                 except Exception:
                     pass  # never let one deployment wedge the loop
+            try:
+                self._refresh_replica_nodes()
+            except Exception:
+                pass
 
     def _reconcile_one(self, ray_tpu, name: str, app: Dict[str, Any]):
         # 1. health: drop replicas that fail a health probe
@@ -368,7 +485,7 @@ class ServeController:
         app["draining"] = still_draining
         started = []
         while len(alive) + len(started) < desired:
-            started.append(self._start_replica(app))
+            started.append(self._start_replica(app, name))
             changed = True
         for r in started:
             try:
@@ -390,6 +507,11 @@ class ServeController:
                 current = self.apps.get(name) is app
                 if current:
                     app["replicas"] = alive
+                    live_ids = {r._actor_id for r in alive} | {
+                        v._actor_id for v, _ in app.get("draining", [])}
+                    app["replica_names"] = {
+                        aid: rn for aid, rn in app["replica_names"].items()
+                        if aid in live_ids}
                     self._version_counter += 1
                     app["version"] = self._version_counter
             if not current:
@@ -400,6 +522,8 @@ class ServeController:
                         ray_tpu.kill(r)
                     except Exception:
                         pass
+            else:
+                self._save_checkpoint()
 
     # ---- handle-facing RPCs ------------------------------------------------
 
@@ -410,9 +534,30 @@ class ServeController:
                 return None
             if known_version == app["version"]:
                 return {"version": app["version"], "unchanged": True}
+            ids = [r._actor_id for r in app["replicas"]]
+            nodes = app.get("replica_nodes", {})
             return {"version": app["version"],
-                    "replica_ids": [r._actor_id for r in app["replicas"]],
+                    "replica_ids": ids,
+                    "replica_nodes": [nodes.get(i, "") for i in ids],
                     "max_ongoing": app["max_ongoing"]}
+
+    def _refresh_replica_nodes(self) -> None:
+        """Map replica actor ids to their nodes (for locality-aware
+        routing; reference: pow_2_scheduler.py prefers same-node
+        replicas)."""
+        import ray_tpu
+
+        try:
+            actors = ray_tpu.api._worker().head.call("list_actors",
+                                                     timeout=10)["actors"]
+        except Exception:
+            return
+        node_of = {a["actor_id"]: a.get("node_id", "") for a in actors}
+        with self._lock:
+            for app in self.apps.values():
+                app["replica_nodes"] = {
+                    r._actor_id: node_of.get(r._actor_id, "")
+                    for r in app["replicas"]}
 
     def report_metrics(self, name: str, handle_id: str, ongoing: int):
         with self._lock:
@@ -434,6 +579,7 @@ class ServeController:
                     ray_tpu.kill(h)
                 except Exception:
                     pass
+            self._save_checkpoint()
         return True
 
     def list_deployments(self):
@@ -581,23 +727,32 @@ class DeploymentHandle:
 
     REFRESH_PERIOD_S = 1.0
 
-    def __init__(self, name: str, replica_ids: List[str], version: int = 0):
+    def __init__(self, name: str, replica_ids: List[str], version: int = 0,
+                 replica_nodes: Optional[List[str]] = None,
+                 max_ongoing: int = 8):
         import uuid
+
+        from ray_tpu._private.worker import global_worker_or_none
 
         self._name = name
         self._handle_id = uuid.uuid4().hex[:12]
         self._lock = threading.Lock()
         self._version = version
-        self._set_replicas(replica_ids)
+        self._max_ongoing = max_ongoing
+        w = global_worker_or_none()
+        self._my_node = w.node_id if w is not None else ""
+        self._set_replicas(replica_ids, replica_nodes)
         self._last_refresh = time.monotonic()
         self._samples: List[int] = []  # recent inflight samples (window)
         self._last_push = 0.0
         _metrics_pusher.register(self)
 
-    def _set_replicas(self, replica_ids: List[str]):
+    def _set_replicas(self, replica_ids: List[str],
+                      replica_nodes: Optional[List[str]] = None):
         from ray_tpu.api import ActorHandle
 
         self._replicas = [ActorHandle(rid) for rid in replica_ids]
+        self._replica_nodes = dict(zip(replica_ids, replica_nodes or []))
         # inflight is keyed by actor id so counts survive replica-list
         # swaps: late completion callbacks decrement the right counter
         # instead of corrupting a rebuilt positional array
@@ -616,16 +771,24 @@ class DeploymentHandle:
             info = ray_tpu.get(
                 ctrl.get_replicas.remote(self._name, self._version),
                 timeout=30)
-        except ray_tpu.RayError:
+        except Exception:
+            # refresh is best-effort: during a controller restart the
+            # cached replica set (detached actors, still alive) keeps
+            # serving — a failed refresh must not fail the request
             return
         if info is None or info.get("unchanged"):
             return
         if info["version"] != self._version:
             with self._lock:
                 self._version = info["version"]
-                self._set_replicas(info["replica_ids"])
+                self._max_ongoing = info.get("max_ongoing",
+                                             self._max_ongoing)
+                self._set_replicas(info["replica_ids"],
+                                   info.get("replica_nodes"))
 
     def remote(self, *args, _method: str = "__call__", **kwargs):
+        import random
+
         self._maybe_refresh()
         if not self._replicas:
             self._maybe_refresh(force=True)
@@ -633,7 +796,20 @@ class DeploymentHandle:
             if not self._replicas:
                 raise RuntimeError(
                     f"deployment {self._name!r} has no replicas")
-            replica = min(self._replicas,
+            # locality-aware power-of-two (reference:
+            # pow_2_scheduler.py:717): prefer same-node replicas only
+            # while they have queue headroom — a saturated local replica
+            # must not absorb all ingress while remote ones sit idle —
+            # then sample two candidates, take the fewer-outstanding one
+            local = [r for r in self._replicas
+                     if self._replica_nodes.get(r._actor_id)
+                     == self._my_node
+                     and self._inflight.get(r._actor_id, 0)
+                     < self._max_ongoing] if self._my_node else []
+            pool = local or self._replicas
+            if len(pool) > 2:
+                pool = random.sample(pool, 2)
+            replica = min(pool,
                           key=lambda r: self._inflight.get(r._actor_id, 0))
             rid = replica._actor_id
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
@@ -670,8 +846,18 @@ def _controller():
         return api.ActorClass(ServeController, name=CONTROLLER_NAME,
                               lifetime="detached").remote()
     except ray_tpu.RayError:
-        # lost the creation race to another caller
-        return ray_tpu.get_actor(CONTROLLER_NAME)
+        # lost the creation race to another caller; the winner may not
+        # have registered the name yet — wait it out briefly
+        import time as _time
+
+        deadline = _time.monotonic() + 30
+        while True:
+            try:
+                return ray_tpu.get_actor(CONTROLLER_NAME)
+            except ValueError:
+                if _time.monotonic() >= deadline:
+                    raise
+                _time.sleep(0.2)
 
 
 def run(app: Application, name: Optional[str] = None) -> DeploymentHandle:
@@ -689,14 +875,27 @@ def run(app: Application, name: Optional[str] = None) -> DeploymentHandle:
     return get_handle(dep_name)
 
 
-def get_handle(name: str) -> DeploymentHandle:
+def get_handle(name: str, timeout: float = 30.0) -> DeploymentHandle:
     import ray_tpu
 
-    ctrl = _controller()
-    info = ray_tpu.get(ctrl.get_replicas.remote(name), timeout=60)
+    # ride through a controller crash: the name may briefly resolve to
+    # the dead actor (or to nothing) until a fresh controller registers
+    # and recovers its checkpoint — retry RayErrors within the window
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            ctrl = _controller()
+            info = ray_tpu.get(ctrl.get_replicas.remote(name), timeout=60)
+            break
+        except (ray_tpu.RayError, ValueError):
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.2)
     if info is None:
         raise ValueError(f"no deployment named {name!r}")
-    return DeploymentHandle(name, info["replica_ids"], info["version"])
+    return DeploymentHandle(name, info["replica_ids"], info["version"],
+                            info.get("replica_nodes"),
+                            max_ongoing=info.get("max_ongoing", 8))
 
 
 def delete(name: str):

@@ -172,31 +172,87 @@ class _HttpProxy:
             return ray_tpu.get(handle.remote(arg), timeout=120)
 
 
+def _proxy_name(node_id: str) -> str:
+    return f"{PROXY_NAME}:{node_id[:12]}"
+
+
 def start_http(host: str = "127.0.0.1", port: int = 0):
-    """Start (or fetch) the HTTP ingress; returns (host, port)."""
+    """Start (or fetch) the primary HTTP ingress; returns (host, port).
+
+    One proxy per node (reference: _private/proxy.py runs per-node
+    ingress actors): each proxy is pinned to its node via the implicit
+    ``node:<id>`` resource and binds its own port, so requests enter on
+    any node and route to replicas anywhere with locality-aware
+    balancing.  Returns the primary (first node) proxy's address; use
+    `proxy_addresses()` for all of them.
+    """
+    addrs = start_per_node_http(host, port)
+    if not addrs:
+        raise RuntimeError("HTTP proxy failed to bind")
+    return addrs[0]
+
+
+def start_per_node_http(host: str = "127.0.0.1", port: int = 0):
+    """Ensure a proxy on every node; returns [(host, port), ...].
+
+    A fixed `port` applies only when nodes live on distinct hosts;
+    multi-node-on-one-box tests must use port=0.
+    """
     import ray_tpu
     import ray_tpu.api as rapi
 
-    try:
-        proxy = ray_tpu.get_actor(PROXY_NAME)
-    except ValueError:
+    addrs = []
+    for node in ray_tpu.nodes():
+        nid = node["node_id"]
+        pname = _proxy_name(nid)
         try:
-            proxy = rapi.ActorClass(
-                _HttpProxy, name=PROXY_NAME, lifetime="detached",
-                max_concurrency=16).remote(host, port)
-        except ray_tpu.RayError:
-            proxy = ray_tpu.get_actor(PROXY_NAME)
-    addr = ray_tpu.get(proxy.address.remote(), timeout=60)
-    if addr is None:
-        raise RuntimeError("HTTP proxy failed to bind")
-    return addr[0], addr[1]
+            proxy = ray_tpu.get_actor(pname)
+        except ValueError:
+            try:
+                proxy = rapi.ActorClass(
+                    _HttpProxy, name=pname, lifetime="detached",
+                    max_concurrency=16,
+                    resources={f"node:{nid[:12]}": 0.001},
+                ).remote(host, port)
+            except ray_tpu.RayError:
+                proxy = ray_tpu.get_actor(pname)
+        addr = ray_tpu.get(proxy.address.remote(), timeout=120)
+        if addr is None:
+            # never leave a bind-failed proxy registered under the node
+            # name — it would shadow every future start attempt
+            try:
+                ray_tpu.kill(proxy)
+            except Exception:
+                pass
+            raise RuntimeError(
+                f"HTTP proxy failed to bind on node {nid[:12]} "
+                f"(port {port} in use?)")
+        addrs.append((addr[0], addr[1]))
+    return addrs
+
+
+def proxy_addresses():
+    """Addresses of every live per-node proxy."""
+    import ray_tpu
+
+    out = []
+    for node in ray_tpu.nodes():
+        try:
+            proxy = ray_tpu.get_actor(_proxy_name(node["node_id"]))
+            addr = ray_tpu.get(proxy.address.remote(), timeout=30)
+            if addr is not None:
+                out.append((addr[0], addr[1]))
+        except Exception:
+            continue
+    return out
 
 
 def shutdown_http():
     import ray_tpu
 
-    try:
-        proxy = ray_tpu.get_actor(PROXY_NAME)
-    except ValueError:
-        return
-    ray_tpu.kill(proxy)
+    for node in ray_tpu.nodes():
+        try:
+            proxy = ray_tpu.get_actor(_proxy_name(node["node_id"]))
+            ray_tpu.kill(proxy)
+        except Exception:
+            continue

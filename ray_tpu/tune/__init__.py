@@ -9,9 +9,11 @@ under a concurrency cap; ASHA prunes at rungs.
 
 from ray_tpu.tune.search import (Domain, choice, grid_search, loguniform,
                                  randint, uniform)
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                     PopulationBasedTraining)
 from ray_tpu.tune.tuner import (ResultGrid, TrialResult, TuneConfig, Tuner)
 
 __all__ = ["Tuner", "TuneConfig", "ResultGrid", "TrialResult",
            "grid_search", "choice", "uniform", "loguniform", "randint",
-           "ASHAScheduler", "FIFOScheduler", "Domain"]
+           "ASHAScheduler", "FIFOScheduler", "PopulationBasedTraining",
+           "Domain"]
