@@ -240,10 +240,15 @@ class ServeController:
     def __init__(self):
         self.apps: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.Lock()
+        self._ckpt_lock = threading.Lock()  # serializes checkpoint writes
         self._version_counter = 0  # monotonic across redeploys
         self._stop = threading.Event()
-        self._recover_from_checkpoint()
-        self._sweep_orphan_replicas()  # even when no checkpoint exists
+        recovered = self._recover_from_checkpoint()
+        if recovered:
+            # only sweep when the checkpoint was read reliably: sweeping
+            # after a failed read would kill every live replica the
+            # intact checkpoint still references
+            self._sweep_orphan_replicas()
         self._loop_thread = threading.Thread(
             target=self._reconcile_loop, name="serve-reconcile", daemon=True)
         self._loop_thread.start()
@@ -260,26 +265,33 @@ class ServeController:
 
         from ray_tpu.experimental import internal_kv
 
-        with self._lock:
-            snap = {"version_counter": self._version_counter, "apps": {}}
-            for name, app in self.apps.items():
-                snap["apps"][name] = {
-                    "target_blob": app["target_blob"],
-                    "init_args": app["init_args"],
-                    "init_kwargs": app["init_kwargs"],
-                    "actor_options": app["actor_options"],
-                    "max_ongoing": app["max_ongoing"],
-                    "autoscaling": app["autoscaling"],
-                    "desired": app["desired"],
-                    "version": app["version"],
-                    "replica_names": list(app.get("replica_names", {}).values()),
-                }
-        try:
-            internal_kv.kv_put(self.CHECKPOINT_KEY, cloudpickle.dumps(snap))
-        except Exception:
-            pass  # head briefly unreachable: next mutation re-saves
+        # _ckpt_lock spans snapshot-build AND kv_put so concurrent saves
+        # cannot write an older snapshot after a newer one
+        with self._ckpt_lock:
+            with self._lock:
+                snap = {"version_counter": self._version_counter, "apps": {}}
+                for name, app in self.apps.items():
+                    snap["apps"][name] = {
+                        "target_blob": app["target_blob"],
+                        "init_args": app["init_args"],
+                        "init_kwargs": app["init_kwargs"],
+                        "actor_options": app["actor_options"],
+                        "max_ongoing": app["max_ongoing"],
+                        "autoscaling": app["autoscaling"],
+                        "desired": app["desired"],
+                        "version": app["version"],
+                        "replica_names": list(
+                            app.get("replica_names", {}).values()),
+                    }
+            try:
+                internal_kv.kv_put(self.CHECKPOINT_KEY, cloudpickle.dumps(snap))
+            except Exception:
+                pass  # head briefly unreachable: next mutation re-saves
 
-    def _recover_from_checkpoint(self) -> None:
+    def _recover_from_checkpoint(self) -> bool:
+        """Returns True when the checkpoint state is reliably known
+        (loaded, or confirmed absent).  False means the read failed —
+        callers must NOT treat live replicas as orphans in that case."""
         import cloudpickle
 
         import ray_tpu
@@ -288,13 +300,13 @@ class ServeController:
         try:
             raw = internal_kv.kv_get(self.CHECKPOINT_KEY)
         except Exception:
-            raw = None
+            return False  # head unreachable: checkpoint state unknown
         if not raw:
-            return
+            return True  # confirmed: no checkpoint exists
         try:
             snap = cloudpickle.loads(raw)
         except Exception:
-            return
+            return False  # corrupt read: do not sweep on this basis
         self._version_counter = snap.get("version_counter", 0)
         for name, spec in snap.get("apps", {}).items():
             replicas = []
@@ -319,6 +331,7 @@ class ServeController:
                 "version": spec["version"],
                 "ongoing": {},
             }
+        return True
 
     def _sweep_orphan_replicas(self) -> None:
         """Kill live 'serve:*' replica actors no checkpoint references:
@@ -547,6 +560,9 @@ class ServeController:
         replicas)."""
         import ray_tpu
 
+        with self._lock:
+            if not self.apps:
+                return  # idle controller: skip the cluster-wide RPC
         try:
             actors = ray_tpu.api._worker().head.call("list_actors",
                                                      timeout=10)["actors"]

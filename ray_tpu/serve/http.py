@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Any, Dict, Optional
 from urllib.parse import parse_qsl, urlsplit
 
@@ -215,7 +216,17 @@ def start_per_node_http(host: str = "127.0.0.1", port: int = 0):
                     resources={f"node:{nid[:12]}": 0.001},
                 ).remote(host, port)
             except ray_tpu.RayError:
-                proxy = ray_tpu.get_actor(pname)
+                # name collision: another driver is creating this proxy
+                # concurrently; wait for the winner to register the name
+                deadline = time.monotonic() + 30
+                while True:
+                    try:
+                        proxy = ray_tpu.get_actor(pname)
+                        break
+                    except ValueError:
+                        if time.monotonic() >= deadline:
+                            raise
+                        time.sleep(0.2)
         addr = ray_tpu.get(proxy.address.remote(), timeout=120)
         if addr is None:
             # never leave a bind-failed proxy registered under the node

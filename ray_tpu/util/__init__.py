@@ -1,6 +1,8 @@
 """ray_tpu.util: placement groups, collectives, and cluster utilities."""
 
+from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.placement_group import (PlacementGroup, placement_group,
                                           remove_placement_group)
 
-__all__ = ["PlacementGroup", "placement_group", "remove_placement_group"]
+__all__ = ["ActorPool", "PlacementGroup", "placement_group",
+           "remove_placement_group"]

@@ -275,48 +275,3 @@ def test_controller_crash_recovery(cluster):
     assert ray_tpu.get(ctrl2.list_deployments.remote(),
                        timeout=30).get("durable") == 2
     serve.delete("durable")
-
-
-def test_per_node_proxies():
-    """Every node runs its own ingress; requests entering any node's
-    proxy reach replicas anywhere (reference: per-node proxy actors +
-    long-poll route table)."""
-    import json
-    import urllib.request
-
-    from ray_tpu.cluster_utils import Cluster
-
-    # needs its own 2-node cluster; the module-scoped fixture's runtime
-    # may still be up from earlier tests (this test runs last)
-    try:
-        serve.shutdown()
-    except Exception:
-        pass
-    try:
-        ray_tpu.shutdown()
-    except Exception:
-        pass
-
-    cluster = Cluster(head_node_args={"num_cpus": 2})
-    cluster.add_node(num_cpus=2)
-    ray_tpu.init(address=cluster.address)
-    try:
-        @serve.deployment(name="spread", num_replicas=2)
-        def spread(x):
-            return {"v": x}
-
-        serve.run(spread.bind())
-        addrs = serve.start_per_node_http()
-        assert len(addrs) == 2, addrs
-        for host, port in addrs:
-            with urllib.request.urlopen(
-                    f"http://{host}:{port}/spread?x=7", timeout=30) as r:
-                assert json.loads(r.read()) == {"v": {"x": "7"}}
-        serve.shutdown_http()
-    finally:
-        try:
-            serve.shutdown()
-        except Exception:
-            pass
-        ray_tpu.shutdown()
-        cluster.shutdown()

@@ -1,0 +1,129 @@
+"""ActorPool / Queue / multiprocessing.Pool tests
+(reference: python/ray/tests/test_actor_pool.py, test_queue.py,
+python/ray/util/multiprocessing tests)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.multiprocessing import Pool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@ray_tpu.remote
+class _Doubler:
+    def double(self, v):
+        return 2 * v
+
+
+def test_actor_pool_ordered(local_cluster):
+    pool = ActorPool([_Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [2 * v for v in range(8)]
+
+
+def test_actor_pool_unordered_and_queueing(local_cluster):
+    # 2 actors, 6 items: work must queue behind busy actors
+    pool = ActorPool([_Doubler.remote() for _ in range(2)])
+    out = sorted(pool.map_unordered(lambda a, v: a.double.remote(v), range(6)))
+    assert out == [2 * v for v in range(6)]
+
+
+def test_actor_pool_submit_get_next(local_cluster):
+    pool = ActorPool([_Doubler.remote()])
+    assert not pool.has_next()
+    pool.submit(lambda a, v: a.double.remote(v), 10)
+    pool.submit(lambda a, v: a.double.remote(v), 11)  # queues: 1 actor
+    assert pool.has_next()
+    assert pool.get_next(timeout=30) == 20
+    assert pool.get_next(timeout=30) == 22
+    assert not pool.has_next()
+    with pytest.raises(StopIteration):
+        pool.get_next()
+
+
+def test_actor_pool_push_pop(local_cluster):
+    a, b = _Doubler.remote(), _Doubler.remote()
+    pool = ActorPool([a])
+    popped = pool.pop_idle()
+    assert popped is a
+    assert pool.pop_idle() is None
+    pool.push(b)
+    assert pool.has_free()
+    with pytest.raises(ValueError):
+        pool.push(b)
+
+
+def test_queue_fifo_and_batches(local_cluster):
+    q = Queue(maxsize=5)
+    for i in range(3):
+        q.put(i, timeout=10)
+    assert q.qsize() == 3 and not q.empty() and not q.full()
+    assert [q.get(timeout=10) for _ in range(3)] == [0, 1, 2]
+    q.put_nowait_batch([7, 8, 9])
+    assert q.get_nowait_batch(3) == [7, 8, 9]
+    q.shutdown()
+
+
+def test_queue_empty_full(local_cluster):
+    q = Queue(maxsize=1)
+    q.put_nowait("x")
+    assert q.full()
+    with pytest.raises(Full):
+        q.put_nowait("y")
+    with pytest.raises(Full):
+        q.put("y", timeout=0.2)
+    assert q.get_nowait() == "x"
+    with pytest.raises(Empty):
+        q.get_nowait()
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+    q.shutdown()
+
+
+def test_queue_cross_actor(local_cluster):
+    """A queue handle works from inside another actor."""
+    q = Queue()
+
+    @ray_tpu.remote
+    class Producer:
+        def produce(self, q, n):
+            for i in range(n):
+                q.put(i)
+            return True
+
+    p = Producer.remote()
+    assert ray_tpu.get(p.produce.remote(q, 4), timeout=60)
+    assert sorted(q.get(timeout=10) for _ in range(4)) == [0, 1, 2, 3]
+    q.shutdown()
+
+
+def test_mp_pool_map(local_cluster):
+    _square = lambda x: x * x  # noqa: E731 — by-value pickling for workers
+    with Pool(processes=2) as pool:
+        assert pool.map(_square, range(10)) == [x * x for x in range(10)]
+
+
+def test_mp_pool_apply_starmap_imap(local_cluster):
+    _square = lambda x: x * x  # noqa: E731
+    pool = Pool(processes=2)
+    try:
+        assert pool.apply(divmod, (7, 3)) == (2, 1)
+        res = pool.apply_async(_square, (6,))
+        assert res.get(timeout=60) == 36
+        assert res.successful()
+        assert pool.starmap(pow, [(2, 3), (3, 2)]) == [8, 9]
+        assert list(pool.imap(_square, range(5), chunksize=2)) == \
+            [0, 1, 4, 9, 16]
+        assert sorted(pool.imap_unordered(_square, range(5))) == \
+            [0, 1, 4, 9, 16]
+    finally:
+        pool.terminate()
+
+
+def test_mp_pool_closed_raises(local_cluster):
+    pool = Pool(processes=1)
+    pool.close()
+    with pytest.raises(ValueError):
+        pool.map(len, [[1]])
+    pool.join()
