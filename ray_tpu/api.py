@@ -225,6 +225,13 @@ class RemoteFunction:
             return refs[0]
         return refs
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node instead of submitting now
+        (reference: python/ray/dag/function_node.py)."""
+        from ray_tpu.dag.nodes import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Remote function {self._name} cannot be called directly; "
@@ -364,6 +371,13 @@ class ActorClass:
         owner = self._lifetime != "detached"
         return ActorHandle(actor_id, max_task_retries=self._max_task_retries,
                            _owner=owner)
+
+    def bind(self, *args, **kwargs):
+        """Build an actor DAG node instead of creating the actor now
+        (reference: python/ray/dag/class_node.py)."""
+        from ray_tpu.dag.nodes import ClassNode
+
+        return ClassNode(self, args, kwargs)
 
     def __call__(self, *a, **kw):
         raise TypeError("Actor classes must be instantiated with .remote()")

@@ -1,0 +1,17 @@
+"""DAG authoring API: build task/actor graphs with ``.bind()``, run them
+lazily with ``.execute()``, or compile them (``experimental_compile``)
+into a reusable pipeline over pre-allocated object channels.
+
+Equivalent of the reference's ``ray.dag``
+(reference: python/ray/dag/dag_node.py:1, function_node.py,
+class_node.py, input_node.py, compiled_dag_node.py:174).
+"""
+
+from ray_tpu.dag.nodes import (ClassMethodNode, ClassNode, DAGNode,
+                               FunctionNode, InputAttributeNode, InputNode,
+                               MultiOutputNode)
+from ray_tpu.dag.compiled import CompiledDAG
+
+__all__ = ["DAGNode", "FunctionNode", "ClassNode", "ClassMethodNode",
+           "InputNode", "InputAttributeNode", "MultiOutputNode",
+           "CompiledDAG"]

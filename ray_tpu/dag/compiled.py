@@ -1,0 +1,90 @@
+"""Compiled DAG: freeze a bound task/actor graph into a replayable plan.
+
+Equivalent of the reference's accelerated DAG
+(reference: python/ray/dag/compiled_dag_node.py:174).  The reference
+pre-allocates mutable plasma channels between GPU actors and replays
+the graph without per-call scheduling.  Here compilation:
+
+  * creates every ``ClassNode`` actor exactly once (dynamic ``execute``
+    re-creates them per call);
+  * exports every task function/actor class once so replays skip the
+    function-table round trip;
+  * pipelines successive ``execute`` calls up to ``max_in_flight``
+    before applying backpressure — the driver can keep a TPU serving
+    pipeline full without unbounded queue growth.
+
+Cross-actor data still flows through the object store (refs as task
+args, owner-resolved), which on TPU is the right substrate: device
+arrays stay device-side inside each actor's jitted step and only
+host-level handles cross the wire.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ray_tpu.dag.nodes import (ClassNode, DAGNode, InputNode,
+                               MultiOutputNode)
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, max_in_flight: int = 8):
+        if max_in_flight < 1:
+            raise ValueError("max_in_flight must be >= 1")
+        self._root = root
+        self._order = root.topological()
+        self._max_in_flight = max_in_flight
+        self._in_flight: List[Any] = []
+        inputs = [n for n in self._order if isinstance(n, InputNode)]
+        if len(inputs) > 1:
+            raise ValueError("a DAG can reference at most one InputNode")
+        # actors are part of the compiled plan: created once, reused
+        self._actors: Dict[int, Any] = {}
+        for node in self._order:
+            if isinstance(node, ClassNode):
+                memo: Dict[int, Any] = dict(self._actors)
+                for dep in node.topological():
+                    if id(dep) not in memo:
+                        if isinstance(dep, (InputNode, MultiOutputNode)):
+                            raise ValueError(
+                                "actor constructor args cannot depend on "
+                                "the runtime input")
+                        memo[id(dep)] = dep._apply(memo, (), {})
+                self._actors[id(node)] = memo[id(node)]
+
+    def execute(self, *input_args):
+        """Submit one traversal; returns the root ref (or list of refs).
+        Blocks only when ``max_in_flight`` prior executions are still
+        unfinished."""
+        import ray_tpu
+
+        self._apply_backpressure(ray_tpu)
+        memo: Dict[int, Any] = dict(self._actors)
+        for node in self._order:
+            if id(node) not in memo:
+                memo[id(node)] = node._apply(memo, input_args, {})
+        out = memo[id(self._root)]
+        self._in_flight.append(
+            out[-1] if isinstance(out, list) else out)
+        return out
+
+    def _apply_backpressure(self, ray_tpu):
+        # drop already-finished markers first
+        if self._in_flight:
+            _, pending = ray_tpu.wait(
+                self._in_flight, num_returns=len(self._in_flight), timeout=0)
+            self._in_flight = pending
+        while len(self._in_flight) >= self._max_in_flight:
+            _, self._in_flight = ray_tpu.wait(
+                self._in_flight, num_returns=1, timeout=300)
+
+    def teardown(self):
+        """Kill the plan's actors."""
+        import ray_tpu
+
+        for handle in self._actors.values():
+            try:
+                ray_tpu.kill(handle)
+            except Exception:
+                pass
+        self._actors.clear()

@@ -243,12 +243,23 @@ class ServeController:
         self._ckpt_lock = threading.Lock()  # serializes checkpoint writes
         self._version_counter = 0  # monotonic across redeploys
         self._stop = threading.Event()
-        recovered = self._recover_from_checkpoint()
-        if recovered:
-            # only sweep when the checkpoint was read reliably: sweeping
-            # after a failed read would kill every live replica the
-            # intact checkpoint still references
-            self._sweep_orphan_replicas()
+        recovered = False
+        for _ in range(5):
+            recovered = self._recover_from_checkpoint()
+            if recovered:
+                break
+            time.sleep(1.0)
+        if not recovered:
+            # proceeding with empty state would let the next
+            # _save_checkpoint clobber the intact checkpoint and leak
+            # every replica it references — fail the actor instead so
+            # creation retries with a fresh controller
+            raise RuntimeError(
+                "serve controller could not read its checkpoint")
+        # only sweep when the checkpoint was read reliably: sweeping
+        # after a failed read would kill every live replica the intact
+        # checkpoint still references
+        self._sweep_orphan_replicas()
         self._loop_thread = threading.Thread(
             target=self._reconcile_loop, name="serve-reconcile", daemon=True)
         self._loop_thread.start()

@@ -215,9 +215,10 @@ def start_per_node_http(host: str = "127.0.0.1", port: int = 0):
                     max_concurrency=16,
                     resources={f"node:{nid[:12]}": 0.001},
                 ).remote(host, port)
-            except ray_tpu.RayError:
-                # name collision: another driver is creating this proxy
-                # concurrently; wait for the winner to register the name
+            except Exception as create_exc:
+                # most likely a name collision (an RpcError, not a
+                # RayError): another driver is creating this proxy
+                # concurrently — wait for the winner to register the name
                 deadline = time.monotonic() + 30
                 while True:
                     try:
@@ -225,7 +226,7 @@ def start_per_node_http(host: str = "127.0.0.1", port: int = 0):
                         break
                     except ValueError:
                         if time.monotonic() >= deadline:
-                            raise
+                            raise create_exc
                         time.sleep(0.2)
         addr = ray_tpu.get(proxy.address.remote(), timeout=120)
         if addr is None:

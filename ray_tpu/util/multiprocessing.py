@@ -10,7 +10,10 @@ heuristic so small-item workloads aren't dominated by per-task overhead.
 from __future__ import annotations
 
 import itertools
+import threading
 from typing import Any, Callable, Iterable, List, Optional
+
+from ray_tpu._private.errors import GetTimeoutError
 
 import ray_tpu
 from ray_tpu.util.actor_pool import ActorPool
@@ -42,25 +45,41 @@ class AsyncResult:
         self._value = None
         self._exc: Optional[BaseException] = None
         self._fetched = False
+        self._lock = threading.Lock()
+        if callback is not None or error_callback is not None:
+            # stdlib fires callbacks when the result completes, not when
+            # the caller asks for it
+            threading.Thread(target=self._fetch, daemon=True,
+                             name="mp-pool-callback").start()
 
-    def _fetch(self, timeout=None):
-        if self._fetched:
-            return
-        try:
-            chunks = ray_tpu.get(self._refs, timeout=timeout)
-            out = list(itertools.chain.from_iterable(chunks)) \
-                if self._unchunk else chunks
-            self._value = out[0] if self._single else out
-            if self._callback is not None:
-                self._callback(self._value)
-        except BaseException as exc:  # noqa: BLE001 — surfaced via get()
-            self._exc = exc
-            if self._error_callback is not None:
-                self._error_callback(exc)
-        self._fetched = True
+    def _fetch(self):
+        """Resolve and cache the final outcome; refs must be complete
+        (or the caller accepts blocking until they are)."""
+        with self._lock:
+            if self._fetched:
+                return
+            try:
+                chunks = ray_tpu.get(self._refs)
+                out = list(itertools.chain.from_iterable(chunks)) \
+                    if self._unchunk else chunks
+                self._value = out[0] if self._single else out
+                if self._callback is not None:
+                    self._callback(self._value)
+            except BaseException as exc:  # noqa: BLE001 — via get()
+                self._exc = exc
+                if self._error_callback is not None:
+                    self._error_callback(exc)
+            self._fetched = True
 
     def get(self, timeout: Optional[float] = None) -> Any:
-        self._fetch(timeout)
+        # wait OUTSIDE the cache lock: a timed-out get must not poison
+        # the result, and must not block on the callback thread's fetch
+        if not self._fetched and timeout is not None:
+            ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                                    timeout=timeout)
+            if len(ready) < len(self._refs):
+                raise GetTimeoutError("result not ready within timeout")
+        self._fetch()
         if self._exc is not None:
             raise self._exc
         return self._value
@@ -104,6 +123,7 @@ class Pool:
         self._actors = [cls.remote(initializer, tuple(initargs))
                         for _ in range(processes)]
         self._closed = False
+        self._next_apply = 0  # round-robins apply/apply_async
 
     def _check_running(self):
         if self._closed:
@@ -132,7 +152,9 @@ class Pool:
     def apply_async(self, fn: Callable, args=(), kwds=None,
                     callback=None, error_callback=None) -> AsyncResult:
         self._check_running()
-        ref = self._actors[0].run_call.remote(fn, tuple(args), kwds or {})
+        actor = self._actors[self._next_apply % len(self._actors)]
+        self._next_apply += 1
+        ref = actor.run_call.remote(fn, tuple(args), kwds or {})
         return AsyncResult([ref], single=True, unchunk=True,
                            callback=callback, error_callback=error_callback)
 

@@ -42,25 +42,30 @@ class _QueueActor:
         except Empty:
             return False, None
 
-    def put_nowait_batch(self, items: List[Any]) -> int:
-        """Puts as many as fit; returns how many were accepted."""
-        n = 0
-        for item in items:
-            try:
-                self._q.put_nowait(item)
-                n += 1
-            except Full:
-                break
-        return n
+    def put_nowait_batch(self, items: List[Any]) -> bool:
+        """All-or-nothing: atomically accepts the whole batch or none."""
+        q = self._q
+        with q.not_full:  # the Condition shares q.mutex
+            if q.maxsize > 0 and len(q.queue) + len(items) > q.maxsize:
+                return False
+            q.queue.extend(items)
+            q.unfinished_tasks += len(items)
+            q.not_empty.notify(len(items))
+            return True
 
-    def get_nowait_batch(self, max_items: int) -> List[Any]:
-        out = []
-        for _ in range(max_items):
-            try:
-                out.append(self._q.get_nowait())
-            except Empty:
-                break
-        return out
+    def get_nowait_batch(self, num_items: int,
+                         allow_partial: bool) -> Optional[List[Any]]:
+        """Atomically drains num_items (or up to that many when
+        allow_partial).  None = not enough items; nothing was drained."""
+        q = self._q
+        with q.not_empty:
+            avail = len(q.queue)
+            if avail < num_items and not allow_partial:
+                return None
+            take = min(num_items, avail)
+            out = [q.queue.popleft() for _ in range(take)]
+            q.not_full.notify(take)
+            return out
 
     def qsize(self) -> int:
         return self._q.qsize()
@@ -112,30 +117,27 @@ class Queue:
     # ----------------------------------------------------------- nonblocking
 
     def put_nowait(self, item: Any) -> None:
-        if ray_tpu.get(self.actor.put_nowait_batch.remote([item]),
-                       timeout=60) != 1:
+        if not ray_tpu.get(self.actor.put_nowait_batch.remote([item]),
+                           timeout=60):
             raise Full
 
     def get_nowait(self) -> Any:
-        out = ray_tpu.get(self.actor.get_nowait_batch.remote(1), timeout=60)
-        if not out:
+        out = ray_tpu.get(self.actor.get_nowait_batch.remote(1, False),
+                          timeout=60)
+        if out is None:
             raise Empty
         return out[0]
 
     def put_nowait_batch(self, items: List[Any]) -> None:
-        accepted = ray_tpu.get(self.actor.put_nowait_batch.remote(list(items)),
-                               timeout=60)
-        if accepted != len(items):
-            raise Full(f"only {accepted}/{len(items)} items fit")
+        if not ray_tpu.get(self.actor.put_nowait_batch.remote(list(items)),
+                           timeout=60):
+            raise Full(f"batch of {len(items)} does not fit")
 
     def get_nowait_batch(self, num_items: int) -> List[Any]:
-        out = ray_tpu.get(self.actor.get_nowait_batch.remote(num_items),
+        out = ray_tpu.get(self.actor.get_nowait_batch.remote(num_items, False),
                           timeout=60)
-        if len(out) != num_items:
-            # restore drained items is racy; mirror the reference and raise
-            for item in out:
-                self.put_nowait(item)
-            raise Empty(f"requested {num_items}, only {len(out)} available")
+        if out is None:
+            raise Empty(f"fewer than {num_items} items available")
         return out
 
     # ------------------------------------------------------------ inspection

@@ -1,0 +1,204 @@
+"""DAG API, compiled DAG, and durable workflow tests
+(reference: python/ray/dag/tests/, python/ray/workflow/tests/)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+# ------------------------------------------------------------------ dag
+
+
+def test_dag_dynamic_execute(local_cluster):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    dag = mul.bind(add.bind(1, 2), add.bind(3, 4))  # (1+2) * (3+4)
+    assert ray_tpu.get(dag.execute(), timeout=60) == 21
+
+
+def test_dag_input_node(local_cluster):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    with InputNode() as inp:
+        dag = add.bind(double.bind(inp), inp)
+    assert ray_tpu.get(dag.execute(10), timeout=60) == 30
+
+
+def test_dag_input_projection(local_cluster):
+    @ray_tpu.remote
+    def combine(a, b):
+        return a - b
+
+    with InputNode() as inp:
+        dag = combine.bind(inp["hi"], inp["lo"])
+    assert ray_tpu.get(dag.execute({"hi": 9, "lo": 4}), timeout=60) == 5
+
+
+def test_dag_actor_nodes(local_cluster):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.v = start
+
+        def add(self, x):
+            self.v += x
+            return self.v
+
+    node = Counter.bind(100)
+    dag = node.add.bind(5)
+    assert ray_tpu.get(dag.execute(), timeout=60) == 105
+    # dynamic execute creates a FRESH actor per call
+    assert ray_tpu.get(dag.execute(), timeout=60) == 105
+
+
+def test_compiled_dag_reuses_actors(local_cluster):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.v = 0
+
+        def add(self, x):
+            self.v += x
+            return self.v
+
+    with InputNode() as inp:
+        dag = Counter.bind().add.bind(inp)
+    compiled = dag.experimental_compile(max_in_flight=2)
+    try:
+        # same actor across executes: state accumulates
+        assert ray_tpu.get(compiled.execute(1), timeout=60) == 1
+        assert ray_tpu.get(compiled.execute(2), timeout=60) == 3
+        refs = [compiled.execute(1) for _ in range(6)]  # exceeds in-flight cap
+        assert ray_tpu.get(refs[-1], timeout=60) == 9
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_multi_output(local_cluster):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def dec(x):
+        return x - 1
+
+    with InputNode() as inp:
+        dag = MultiOutputNode([inc.bind(inp), dec.bind(inp)])
+    compiled = dag.experimental_compile()
+    out = compiled.execute(10)
+    assert ray_tpu.get(out, timeout=60) == [11, 9]
+
+
+# ------------------------------------------------------------- workflow
+
+
+@pytest.fixture
+def wf_storage(tmp_path):
+    workflow.init(str(tmp_path / "wf"))
+    yield str(tmp_path / "wf")
+    workflow.init(None)
+
+
+def test_workflow_run_and_memoized_rerun(local_cluster, wf_storage, tmp_path):
+    marker = str(tmp_path / "runs")
+
+    @ray_tpu.remote
+    def record(x):
+        with open(marker, "a") as f:
+            f.write("x")
+        return x * 10
+
+    @ray_tpu.remote
+    def total(*parts):
+        return sum(parts)
+
+    dag = total.bind(record.bind(1), record.bind(2))
+    assert workflow.run(dag, workflow_id="w1") == 30
+    assert workflow.get_status("w1") == "SUCCEEDED"
+    assert workflow.get_output("w1") == 30
+    n_runs = len(open(marker).read())
+    assert n_runs == 2
+    # finished workflow: result served from storage, steps NOT re-run
+    assert workflow.run(dag, workflow_id="w1") == 30
+    assert len(open(marker).read()) == n_runs
+    assert ("w1", "SUCCEEDED") in workflow.list_all()
+
+
+def test_workflow_crash_resume_skips_done_steps(local_cluster, wf_storage,
+                                                tmp_path):
+    ok_flag = str(tmp_path / "ok")
+    count_a = str(tmp_path / "a_runs")
+
+    @ray_tpu.remote(max_retries=0)
+    def step_a():
+        with open(count_a, "a") as f:
+            f.write("x")
+        return 7
+
+    @ray_tpu.remote(max_retries=0)
+    def step_b(a):
+        if not os.path.exists(ok_flag):
+            raise RuntimeError("transient outage")
+        return a + 1
+
+    dag = step_b.bind(step_a.bind())
+    with pytest.raises(ray_tpu.RayError):
+        workflow.run(dag, workflow_id="w2")
+    assert workflow.get_status("w2") == "FAILED"
+    assert len(open(count_a).read()) == 1
+
+    open(ok_flag, "w").close()  # outage over
+    assert workflow.resume("w2") == 8
+    assert workflow.get_status("w2") == "SUCCEEDED"
+    # step_a's checkpoint was reused — it ran exactly once overall
+    assert len(open(count_a).read()) == 1
+
+
+def test_workflow_continuation(local_cluster, wf_storage):
+    @ray_tpu.remote
+    def fib(n, a=0, b=1):
+        if n == 0:
+            return a
+        return workflow.continuation(fib.bind(n - 1, b, a + b))
+
+    assert workflow.run(fib.bind(10), workflow_id="w3") == 55
+    assert workflow.get_output("w3") == 55
+
+
+def test_workflow_run_async_and_delete(local_cluster, wf_storage):
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    fut = workflow.run_async(one.bind(), workflow_id="w4")
+    assert fut.result(timeout=120) == 1
+    workflow.delete("w4")
+    with pytest.raises(ValueError):
+        workflow.get_status("w4")
+
+
+def test_workflow_rejects_actors(local_cluster, wf_storage):
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return 1
+
+    with pytest.raises(TypeError):
+        workflow.run(A.bind().m.bind(), workflow_id="w5")

@@ -127,3 +127,44 @@ def test_mp_pool_closed_raises(local_cluster):
     with pytest.raises(ValueError):
         pool.map(len, [[1]])
     pool.join()
+
+
+def test_queue_batch_failure_drains_nothing(local_cluster):
+    q = Queue(maxsize=5)
+    q.put_nowait_batch([1, 2])
+    with pytest.raises(Empty):
+        q.get_nowait_batch(3)  # atomic: must not drain the 2 items
+    assert q.qsize() == 2
+    with pytest.raises(Full):
+        q.put_nowait_batch([3, 4, 5, 6])  # atomic: nothing inserted
+    assert q.qsize() == 2
+    q.shutdown()
+
+
+def test_mp_pool_timed_out_get_recovers(local_cluster):
+    import time as _t
+
+    pool = Pool(processes=1)
+    try:
+        res = pool.apply_async(_t.sleep, (1.5,))
+        with pytest.raises(ray_tpu.GetTimeoutError):
+            res.get(timeout=0.2)
+        assert res.get(timeout=30) is None  # still succeeds afterwards
+        assert res.successful()
+    finally:
+        pool.terminate()
+
+
+def test_mp_pool_callback_fires_without_get(local_cluster):
+    import time as _t
+
+    hits = []
+    pool = Pool(processes=1)
+    try:
+        pool.apply_async(int, ("42",), callback=hits.append)
+        deadline = _t.time() + 30
+        while not hits and _t.time() < deadline:
+            _t.sleep(0.1)
+        assert hits == [42]
+    finally:
+        pool.terminate()
