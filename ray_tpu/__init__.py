@@ -9,7 +9,8 @@ Core surface (reference: python/ray/__init__.py):
 from ray_tpu._private.errors import (ActorDiedError, ActorUnavailableError,
                                      GetTimeoutError, ObjectFreedError,
                                      ObjectLostError, RayError, RayTaskError,
-                                     RayWorkerError, SchedulingError)
+                                     RayWorkerError, RuntimeEnvSetupError,
+                                     SchedulingError)
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu.api import (ActorClass, ActorHandle, RemoteFunction,
                          available_resources, cluster_resources, get,
@@ -24,5 +25,6 @@ __all__ = [
     "ObjectRef", "ActorHandle", "ActorClass", "RemoteFunction",
     "RayError", "RayTaskError", "RayWorkerError", "ActorDiedError",
     "ActorUnavailableError", "ObjectLostError", "ObjectFreedError",
-    "GetTimeoutError", "SchedulingError", "__version__",
+    "GetTimeoutError", "SchedulingError", "RuntimeEnvSetupError",
+    "__version__",
 ]

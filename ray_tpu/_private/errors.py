@@ -59,3 +59,8 @@ class GetTimeoutError(RayError, TimeoutError):
 
 class SchedulingError(RayError):
     """The task's resource demand can never be satisfied by the cluster."""
+
+
+class RuntimeEnvSetupError(RayError):
+    """The task's runtime environment could not be prepared on the node
+    (reference: python/ray/exceptions.py RuntimeEnvSetupError)."""

@@ -653,6 +653,16 @@ class HeadService(RpcHost):
                 await asyncio.sleep(delay)
                 continue
             if "granted" not in lease:
+                if lease.get("error") == "runtime env setup failed":
+                    # deterministic failure: retrying other nodes cannot
+                    # fix a missing/broken env package — fail fast
+                    actor.state = DEAD
+                    actor.death_cause = lease.get(
+                        "error_str", "runtime env setup failed")
+                    if actor.name:
+                        self.named_actors.pop(actor.name, None)
+                    actor.wake()
+                    return
                 await asyncio.sleep(delay)
                 continue
             g = lease["granted"]

@@ -33,6 +33,7 @@ import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private.config import config
+from ray_tpu._private.errors import RuntimeEnvSetupError
 from ray_tpu._private.ids import NodeID, WorkerID
 from ray_tpu._private.object_store import StoreCore
 from ray_tpu._private.resources import NodeResources, ResourceSet
@@ -43,9 +44,10 @@ from ray_tpu._private.task_spec import TaskSpec
 
 class _Worker:
     __slots__ = ("worker_id", "pid", "proc", "port", "ready", "lease_id",
-                 "started_at")
+                 "started_at", "env_key", "idle_since")
 
-    def __init__(self, worker_id: str, proc: subprocess.Popen):
+    def __init__(self, worker_id: str, proc: subprocess.Popen,
+                 env_key: str = ""):
         self.worker_id = worker_id
         self.proc = proc
         self.pid = proc.pid
@@ -53,6 +55,11 @@ class _Worker:
         self.ready = asyncio.Event()
         self.lease_id: Optional[str] = None
         self.started_at = time.monotonic()
+        # workers are pooled per runtime-env identity: an env-X lease
+        # never reuses an env-Y worker (reference: worker_pool.h keys
+        # idle workers by runtime env hash)
+        self.env_key = env_key
+        self.idle_since = time.monotonic()
 
 
 class _Lease:
@@ -393,9 +400,15 @@ class NodeAgent(RpcHost):
 
     # ---- worker pool -------------------------------------------------------
 
-    def _spawn_worker(self) -> _Worker:
+    def _spawn_worker(self, env_key: str = "",
+                      extra_env: Optional[Dict[str, str]] = None,
+                      working_dir: Optional[str] = None,
+                      path_dirs: Optional[List[str]] = None) -> _Worker:
         worker_id = WorkerID.from_random().hex()
         env = dict(os.environ)
+        # user env_vars first: the runtime-env control vars below must win
+        if extra_env:
+            env.update(extra_env)
         env.update({
             "RT_HEAD_HOST": self.head_addr[0],
             "RT_HEAD_PORT": str(self.head_addr[1]),
@@ -406,6 +419,10 @@ class NodeAgent(RpcHost):
             "RT_WORKER_ID": worker_id,
             "RT_SESSION_DIR": self.session_dir,
         })
+        if working_dir:
+            env["RT_WORKING_DIR"] = working_dir
+        if path_dirs:
+            env["RT_PY_MODULES"] = os.pathsep.join(path_dirs)
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
         out = open(os.path.join(log_dir, f"worker-{worker_id[:12]}.log"), "ab")
@@ -417,7 +434,7 @@ class NodeAgent(RpcHost):
             cmd, env=env, stdout=out, stderr=subprocess.STDOUT,
             start_new_session=True, preexec_fn=set_pdeathsig)
         out.close()
-        w = _Worker(worker_id, proc)
+        w = _Worker(worker_id, proc, env_key=env_key)
         self._workers[worker_id] = w
         self._starting += 1
         return w
@@ -430,17 +447,30 @@ class NodeAgent(RpcHost):
         self._starting = max(0, self._starting - 1)
         if not w.ready.is_set():
             w.ready.set()
+            w.idle_since = time.monotonic()
             self._idle.append(w)
         self._drain_lease_queue()
         return {"ok": True, "node_id": self.node_id}
 
     async def _reap_loop(self):
-        """Poll child processes for deaths (reference: raylet SIGCHLD)."""
+        """Poll child processes for deaths (reference: raylet SIGCHLD),
+        and retire idle runtime-env workers: env-keyed workers can only
+        serve their own env, so without a timeout every distinct env
+        would permanently leak one process (reference: worker_pool.h
+        kill_idle_workers / idle_worker_killing_time_threshold)."""
         while True:
             await asyncio.sleep(0.2)
             for wid, w in list(self._workers.items()):
                 if w.proc.poll() is not None:
                     self._on_worker_dead(wid, f"exit code {w.proc.returncode}")
+            cutoff = time.monotonic() - config.worker_idle_timeout_ms / 1000.0
+            for w in [w for w in self._idle
+                      if w.env_key and w.idle_since < cutoff]:
+                try:
+                    w.proc.kill()
+                except Exception:
+                    pass
+                self._on_worker_dead(w.worker_id, "idle env worker retired")
 
     def _on_worker_dead(self, worker_id: str, reason: str):
         w = self._workers.pop(worker_id, None)
@@ -561,7 +591,7 @@ class NodeAgent(RpcHost):
         if not self.resources.is_feasible(demand):
             return {"error": "infeasible",
                     "error_str": f"node cannot satisfy {demand.to_dict()}"}
-        return await self._acquire_and_grant(self.local, demand, "")
+        return await self._acquire_and_grant(self.local, demand, "", ts)
 
     def _demand_is_scalable(self, demand: ResourceSet) -> bool:
         """True if some autoscaler-launchable node type could fit this."""
@@ -577,12 +607,13 @@ class NodeAgent(RpcHost):
             return {"error": "infeasible",
                     "error_str": f"demand {demand.to_dict()} exceeds bundle "
                                  f"{key} capacity"}
-        return await self._acquire_and_grant(sched, demand, key)
+        return await self._acquire_and_grant(sched, demand, key, ts)
 
     async def _acquire_and_grant(self, sched: LocalScheduler,
-                                 demand: ResourceSet, bundle_key: str):
+                                 demand: ResourceSet, bundle_key: str,
+                                 ts: Optional[TaskSpec] = None):
         if sched.try_acquire(demand):
-            return await self._grant(sched, demand, bundle_key)
+            return await self._grant(sched, demand, bundle_key, ts)
         # queue FIFO-with-resources
         token = object()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -601,7 +632,7 @@ class NodeAgent(RpcHost):
                     return {"error": "bundle not reserved",
                             "error_str": "placement group removed while queued"}
                 # granted between timeout and cancel; resources are ours
-                return await self._grant(sched, demand, bundle_key)
+                return await self._grant(sched, demand, bundle_key, ts)
             # if not found and fut is cancelled, _grant_token already gave
             # the acquired resources back — nothing more to do here
             return {"error": "lease timeout",
@@ -609,7 +640,7 @@ class NodeAgent(RpcHost):
         if bundle_key and bundle_key not in self._bundles:
             return {"error": "bundle not reserved",
                     "error_str": "placement group removed while queued"}
-        return await self._grant(sched, demand, bundle_key)
+        return await self._grant(sched, demand, bundle_key, ts)
 
     def _grant_token(self, token: object):
         entry = self._lease_waiters.pop(token, None)
@@ -629,9 +660,17 @@ class NodeAgent(RpcHost):
                 self._grant_token(tok)
 
     async def _grant(self, sched: LocalScheduler, demand: ResourceSet,
-                     bundle_key: str = ""):
+                     bundle_key: str = "", ts: Optional[TaskSpec] = None):
         # `demand` resources are already acquired from `sched`
-        worker = await self._pop_worker()
+        renv = ts.runtime_env if ts is not None else {}
+        try:
+            worker = await self._pop_worker(renv)
+        except RuntimeEnvSetupError as exc:
+            worker = None
+            for tok in sched.release(demand):
+                self._grant_token(tok)
+            return {"error": "runtime env setup failed",
+                    "error_str": str(exc)}
         if worker is None:
             for tok in sched.release(demand):
                 self._grant_token(tok)
@@ -649,14 +688,37 @@ class NodeAgent(RpcHost):
             "node_id": self.node_id,
         }}
 
-    async def _pop_worker(self) -> Optional[_Worker]:
+    async def _pop_worker(self, renv: Optional[Dict[str, Any]] = None
+                          ) -> Optional[_Worker]:
+        from ray_tpu._private.runtime_env import env_key as _env_key
+
+        renv = renv or {}
+        key = _env_key(renv)
+        spawn_kwargs: Dict[str, Any] = {}
+        if renv:
+            # materialize BEFORE spawning: fetch/extract packages once
+            # per content hash (cached under session_dir/runtime_envs)
+            from ray_tpu._private import runtime_env as renv_mod
+
+            try:
+                env_vars, working_dir, path_dirs = await renv_mod.materialize(
+                    renv, self.session_dir, self._head)
+            except Exception as exc:
+                raise RuntimeEnvSetupError(
+                    f"runtime env materialization failed: {exc}") from exc
+            spawn_kwargs = {"env_key": key, "extra_env": env_vars,
+                            "working_dir": working_dir,
+                            "path_dirs": path_dirs}
         for _attempt in range(3):
-            while self._idle:
-                w = self._idle.pop()
+            for i in range(len(self._idle) - 1, -1, -1):
+                w = self._idle[i]
+                if w.env_key != key:
+                    continue
+                del self._idle[i]
                 if w.proc.poll() is None:
                     return w
                 self._on_worker_dead(w.worker_id, "dead on pop")
-            w = self._spawn_worker()
+            w = self._spawn_worker(**spawn_kwargs)
             try:
                 await asyncio.wait_for(w.ready.wait(),
                                        config.worker_register_timeout_s)
@@ -700,6 +762,7 @@ class NodeAgent(RpcHost):
             except Exception:
                 pass
         else:
+            w.idle_since = time.monotonic()
             self._idle.append(w)
         for tok in self._lease_sched(lease).release(lease.resources):
             self._grant_token(tok)

@@ -89,8 +89,11 @@ class TaskSpec:
         function descriptor — including the function keeps per-class
         service-time stats meaningful, so one fast function can't drag a
         slow one into deep pipelining)."""
+        from ray_tpu._private.runtime_env import env_key
+
         return (ResourceSet(self.resources).key(), self.kind,
-                self.function_id, self.placement_group_id, self.bundle_index)
+                self.function_id, self.placement_group_id, self.bundle_index,
+                env_key(self.runtime_env))
 
     def to_wire(self) -> Dict[str, Any]:
         return {

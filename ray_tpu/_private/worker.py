@@ -43,7 +43,7 @@ from ray_tpu._private.config import config
 from ray_tpu._private.errors import (ActorDiedError, GetTimeoutError,
                                      ObjectFreedError, ObjectLostError,
                                      RayTaskError, RayWorkerError,
-                                     SchedulingError)
+                                     RuntimeEnvSetupError, SchedulingError)
 from ray_tpu._private.function_manager import FunctionManager
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.memory_store import MemoryStore
@@ -191,6 +191,7 @@ class CoreWorker(RpcHost):
         self.memory = MemoryStore()
         self.rc = ReferenceCounter(self._free_object)
         self.functions = FunctionManager(self.head)
+        self.job_runtime_env: Dict[str, Any] = {}  # init(runtime_env=...)
         self._locations: Dict[str, Tuple[str, int]] = {}  # owned oid -> node
         self._containers: Dict[str, List[ObjectRef]] = {}  # outer -> inner pins
         # lineage reconstruction (reference: object_recovery_manager.cc +
@@ -809,8 +810,11 @@ class CoreWorker(RpcHost):
     def submit_task(self, function_id: str, args: tuple, kwargs: dict,
                     num_returns: int = 1, resources: Optional[Dict[str, float]] = None,
                     max_retries: int = 3, name: str = "",
+                    runtime_env: Optional[Dict[str, Any]] = None,
                     placement_group_id: str = "",
                     bundle_index: int = -1) -> List[ObjectRef]:
+        from ray_tpu._private.runtime_env import merge as _renv_merge
+
         tid = TaskID.for_normal_task(JobID.from_hex(self.job_id))
         wire_args, contained = self._serialize_args(args, kwargs)
         spec = TaskSpec(
@@ -818,6 +822,7 @@ class CoreWorker(RpcHost):
             function_id=function_id, args=wire_args, num_returns=num_returns,
             resources=resources or {"CPU": 1}, max_retries=max_retries,
             name=name, owner_addr=self.address, caller_id=self.worker_id,
+            runtime_env=_renv_merge(self.job_runtime_env, runtime_env or {}),
             placement_group_id=placement_group_id,
             bundle_index=max(bundle_index, 0) if placement_group_id else -1)
         task = _TaskState(spec, contained)
@@ -950,6 +955,12 @@ class CoreWorker(RpcHost):
                     return
                 if reply.get("error") == "infeasible":
                     err = SchedulingError(reply.get("error_str", "infeasible"))
+                    while state.pending:
+                        self._fail_task(state.pending.popleft(), err)
+                    return
+                if reply.get("error") == "runtime env setup failed":
+                    err = RuntimeEnvSetupError(
+                        reply.get("error_str", "runtime env setup failed"))
                     while state.pending:
                         self._fail_task(state.pending.popleft(), err)
                     return
@@ -1193,8 +1204,11 @@ class CoreWorker(RpcHost):
                      resources: Optional[Dict[str, float]] = None,
                      max_restarts: int = 0, max_task_retries: int = 0,
                      max_concurrency: int = 1, name: str = "",
+                     runtime_env: Optional[Dict[str, Any]] = None,
                      placement_group_id: str = "",
                      bundle_index: int = -1) -> str:
+        from ray_tpu._private.runtime_env import merge as _renv_merge
+
         aid = ActorID.of(JobID.from_hex(self.job_id))
         tid = TaskID.for_actor_creation(aid)
         wire_args, contained = self._serialize_args(args, kwargs)
@@ -1205,6 +1219,7 @@ class CoreWorker(RpcHost):
             max_restarts=max_restarts, max_concurrency=max_concurrency,
             max_retries=max_task_retries, name=name,
             owner_addr=self.address, caller_id=self.worker_id,
+            runtime_env=_renv_merge(self.job_runtime_env, runtime_env or {}),
             placement_group_id=placement_group_id,
             bundle_index=max(bundle_index, 0) if placement_group_id else -1)
         self.head.call("create_actor", spec=spec.to_wire(), name=name)
@@ -1459,6 +1474,12 @@ class CoreWorker(RpcHost):
         self._exec.task_id = spec.task_id
         self._exec.job_id = spec.job_id
         self._exec.num_returns = spec.num_returns
+        if spec.runtime_env:
+            # nested tasks/actors submitted from inside this task inherit
+            # its (already job-merged, normalized) runtime env — matching
+            # the reference's parent-env inheritance.  Safe worker-wide:
+            # this worker only ever serves tasks of this env_key.
+            self.job_runtime_env = spec.runtime_env
         m = self._get_metrics()
         t0 = time.time()
         self.record_task_event(spec.task_id, "RUNNING", name=spec.name

@@ -23,6 +23,17 @@ def main():
     from ray_tpu._private.ids import JobID
     from ray_tpu._private.worker import CoreWorker, MODE_WORKER, set_global_worker
 
+    # runtime env (reference: default_worker.py applies the env before
+    # task execution): extracted package dirs go on sys.path, and the
+    # working_dir becomes the process cwd
+    for extra in reversed(os.environ.get("RT_PY_MODULES", "").split(os.pathsep)):
+        if extra:
+            sys.path.insert(0, extra)
+    working_dir = os.environ.get("RT_WORKING_DIR")
+    if working_dir:
+        sys.path.insert(0, working_dir)
+        os.chdir(working_dir)
+
     worker = CoreWorker(MODE_WORKER, head, agent, arena, node_id,
                         worker_id=worker_id, job_id=JobID.nil().hex())
     set_global_worker(worker)

@@ -38,6 +38,7 @@ def init(address: Optional[str] = None, *,
          num_cpus: Optional[float] = None,
          resources: Optional[Dict[str, float]] = None,
          object_store_memory: Optional[int] = None,
+         runtime_env: Optional[Dict[str, Any]] = None,
          _system_config: Optional[Dict[str, Any]] = None,
          ignore_reinit_error: bool = False):
     """Start (or connect to) a cluster and attach this process as a driver.
@@ -103,8 +104,29 @@ def init(address: Optional[str] = None, *,
             _global_node = None
         worker = CoreWorker(MODE_DRIVER, head_addr, info["addr"],
                             info["arena_path"], info["node_id"])
+        if runtime_env:
+            # job-level default: every task/actor of this driver inherits
+            # it unless overridden (reference: job_config.runtime_env)
+            from ray_tpu._private import runtime_env as renv_mod
+
+            try:
+                worker.job_runtime_env = renv_mod.normalize(
+                    runtime_env, worker.head)
+            except BaseException:
+                set_global_worker(None)
+                worker.shutdown()
+                _teardown_global_node()
+                raise
         set_global_worker(worker)
         return
+
+
+def _teardown_global_node():
+    global _global_node
+    if _global_node is not None:
+        for p in _global_node["procs"]:
+            p.terminate()
+        _global_node = None
 
 
 def shutdown():
@@ -121,10 +143,8 @@ def shutdown():
                     pass
             set_global_worker(None)
             w.shutdown()
-        if _global_node is not None:
-            for p in _global_node["procs"]:
-                p.terminate()
-            _global_node = None
+        _renv_cache.clear()
+        _teardown_global_node()
 
 
 def put(value: Any) -> ObjectRef:
@@ -180,7 +200,7 @@ class RemoteFunction:
     (reference: python/ray/remote_function.py)."""
 
     _OPT_KEYS = ("num_returns", "num_cpus", "num_gpus", "num_tpus",
-                 "resources", "max_retries", "name",
+                 "resources", "max_retries", "name", "runtime_env",
                  "placement_group", "placement_group_bundle_index")
 
     def __init__(self, fn, **opts):
@@ -218,7 +238,7 @@ class RemoteFunction:
         refs = w.submit_task(
             self._fid(w), args, kwargs, num_returns=self._num_returns,
             resources=self._resources, max_retries=self._max_retries,
-            name=self._name,
+            name=self._name, runtime_env=_normalized_renv(self, w),
             placement_group_id=pg.id if pg is not None else "",
             bundle_index=self._opts.get("placement_group_bundle_index", -1))
         if self._num_returns == 1:
@@ -236,6 +256,30 @@ class RemoteFunction:
         raise TypeError(
             f"Remote function {self._name} cannot be called directly; "
             f"use {self._name}.remote(...)")
+
+
+_renv_cache: Dict[tuple, Dict[str, Any]] = {}
+
+
+def _normalized_renv(handle, w) -> Dict[str, Any]:
+    """Normalize (package + upload) a handle's runtime_env option once
+    per (cluster connection, env content) — NOT per handle: options()
+    mints a fresh handle per call, and re-zipping a working_dir on every
+    submission would cost seconds of CPU each."""
+    import json
+
+    renv = handle._opts.get("runtime_env")
+    if not renv:
+        return {}
+    key = (w.worker_id, json.dumps(renv, sort_keys=True, default=str))
+    cached = _renv_cache.get(key)
+    if cached is None:
+        from ray_tpu._private import runtime_env as renv_mod
+
+        if len(_renv_cache) > 256:  # old connections / envs
+            _renv_cache.clear()
+        cached = _renv_cache[key] = renv_mod.normalize(renv, w.head)
+    return cached
 
 
 def _build_resources(num_cpus, num_gpus, num_tpus, resources,
@@ -327,7 +371,7 @@ class ActorHandle:
 class ActorClass:
     _OPT_KEYS = ("num_cpus", "num_gpus", "num_tpus", "resources",
                  "max_restarts", "max_task_retries", "max_concurrency",
-                 "name", "lifetime",
+                 "name", "lifetime", "runtime_env",
                  "placement_group", "placement_group_bundle_index")
 
     def __init__(self, cls, **opts):
@@ -366,6 +410,7 @@ class ActorClass:
             max_restarts=self._max_restarts,
             max_task_retries=self._max_task_retries,
             max_concurrency=self._max_concurrency, name=self._name,
+            runtime_env=_normalized_renv(self, w),
             placement_group_id=pg.id if pg is not None else "",
             bundle_index=self._opts.get("placement_group_bundle_index", -1))
         owner = self._lifetime != "detached"

@@ -38,19 +38,23 @@ class CompiledDAG:
         inputs = [n for n in self._order if isinstance(n, InputNode)]
         if len(inputs) > 1:
             raise ValueError("a DAG can reference at most one InputNode")
-        # actors are part of the compiled plan: created once, reused
+        # actors AND their constructor dependencies are part of the
+        # compiled plan: resolved once at compile, reused every execute
+        # (re-running a constructor dep per call would repeat its work
+        # and side effects)
+        self._plan_memo: Dict[int, Any] = {}
         self._actors: Dict[int, Any] = {}
         for node in self._order:
             if isinstance(node, ClassNode):
-                memo: Dict[int, Any] = dict(self._actors)
                 for dep in node.topological():
-                    if id(dep) not in memo:
+                    if id(dep) not in self._plan_memo:
                         if isinstance(dep, (InputNode, MultiOutputNode)):
                             raise ValueError(
                                 "actor constructor args cannot depend on "
                                 "the runtime input")
-                        memo[id(dep)] = dep._apply(memo, (), {})
-                self._actors[id(node)] = memo[id(node)]
+                        self._plan_memo[id(dep)] = dep._apply(
+                            self._plan_memo, (), {})
+                self._actors[id(node)] = self._plan_memo[id(node)]
 
     def execute(self, *input_args):
         """Submit one traversal; returns the root ref (or list of refs).
@@ -59,24 +63,27 @@ class CompiledDAG:
         import ray_tpu
 
         self._apply_backpressure(ray_tpu)
-        memo: Dict[int, Any] = dict(self._actors)
+        memo: Dict[int, Any] = dict(self._plan_memo)
         for node in self._order:
             if id(node) not in memo:
                 memo[id(node)] = node._apply(memo, input_args, {})
         out = memo[id(self._root)]
-        self._in_flight.append(
-            out[-1] if isinstance(out, list) else out)
+        # one in-flight *group* per execute: every output ref counts, so
+        # a slow branch of a MultiOutputNode still exerts backpressure
+        self._in_flight.append(list(out) if isinstance(out, list) else [out])
         return out
 
     def _apply_backpressure(self, ray_tpu):
-        # drop already-finished markers first
+        # drop groups whose every ref already finished
         if self._in_flight:
-            _, pending = ray_tpu.wait(
-                self._in_flight, num_returns=len(self._in_flight), timeout=0)
-            self._in_flight = pending
+            flat = [r for g in self._in_flight for r in g]
+            ready, _ = ray_tpu.wait(flat, num_returns=len(flat), timeout=0)
+            done = set(ready)
+            self._in_flight = [g for g in self._in_flight
+                               if not all(r in done for r in g)]
         while len(self._in_flight) >= self._max_in_flight:
-            _, self._in_flight = ray_tpu.wait(
-                self._in_flight, num_returns=1, timeout=300)
+            oldest = self._in_flight.pop(0)
+            ray_tpu.wait(oldest, num_returns=len(oldest), timeout=300)
 
     def teardown(self):
         """Kill the plan's actors."""

@@ -1,0 +1,149 @@
+"""Runtime environment tests (reference: python/ray/tests/test_runtime_env*.py)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.runtime_env import RuntimeEnvError
+
+
+def test_env_vars_task_and_actor(local_cluster):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RT_TEST_FLAVOR": "mango"}})
+    def read():
+        import os as _os
+
+        return _os.environ.get("RT_TEST_FLAVOR")
+
+    assert ray_tpu.get(read.remote(), timeout=60) == "mango"
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"RT_TEST_FLAVOR": "lime"}})
+    class Reader:
+        def read(self):
+            import os as _os
+
+            return _os.environ.get("RT_TEST_FLAVOR")
+
+    r = Reader.remote()
+    assert ray_tpu.get(r.read.remote(), timeout=60) == "lime"
+
+
+def test_env_workers_are_pooled_separately(local_cluster):
+    @ray_tpu.remote
+    def plain_pid():
+        import os as _os
+
+        return _os.getpid()
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"K": "1"}})
+    def env_pid():
+        import os as _os
+
+        return _os.getpid()
+
+    plain = ray_tpu.get(plain_pid.remote(), timeout=60)
+    env1 = ray_tpu.get(env_pid.remote(), timeout=60)
+    env2 = ray_tpu.get(env_pid.remote(), timeout=60)
+    assert plain != env1          # env worker is a different process
+    assert env1 == env2           # same env reuses the pooled worker
+    assert ray_tpu.get(plain_pid.remote(), timeout=60) == plain
+
+
+def test_working_dir(local_cluster, tmp_path):
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "data.txt").write_text("payload-42")
+    (proj / "helper.py").write_text("def val():\n    return 'from-helper'\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(proj)})
+    def use():
+        import helper  # importable: working_dir is on sys.path
+
+        with open("data.txt") as f:  # cwd IS the working_dir
+            return f.read(), helper.val()
+
+    data, helper_val = ray_tpu.get(use.remote(), timeout=60)
+    assert data == "payload-42"
+    assert helper_val == "from-helper"
+
+
+def test_py_modules(local_cluster, tmp_path):
+    mod_dir = tmp_path / "libs"
+    pkg = mod_dir / "mylib"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("ANSWER = 99\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod_dir)]})
+    def use():
+        import mylib
+
+        return mylib.ANSWER
+
+    assert ray_tpu.get(use.remote(), timeout=60) == 99
+
+
+def test_pip_gate(local_cluster):
+    @ray_tpu.remote(runtime_env={"pip": ["numpy"]})
+    def ok():
+        import numpy
+
+        return numpy.__name__
+
+    assert ray_tpu.get(ok.remote(), timeout=60) == "numpy"
+
+    @ray_tpu.remote(runtime_env={"pip": ["surely-not-installed-xyz"]})
+    def nope():
+        return 1
+
+    with pytest.raises(RuntimeEnvError):
+        nope.remote()
+
+
+def test_unknown_key_rejected(local_cluster):
+    @ray_tpu.remote(runtime_env={"container": {"image": "x"}})
+    def f():
+        return 1
+
+    with pytest.raises(RuntimeEnvError):
+        f.remote()
+
+
+def test_job_level_runtime_env(tmp_path):
+    """init(runtime_env=...) applies to every task; task-level overrides
+    merge key-wise."""
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024,
+                 runtime_env={"env_vars": {"JOB_VAR": "base",
+                                           "SHARED": "job"}})
+    try:
+        @ray_tpu.remote
+        def read():
+            import os as _os
+
+            return _os.environ.get("JOB_VAR"), _os.environ.get("SHARED")
+
+        @ray_tpu.remote(runtime_env={"env_vars": {"SHARED": "task"}})
+        def override():
+            import os as _os
+
+            return _os.environ.get("JOB_VAR"), _os.environ.get("SHARED")
+
+        assert ray_tpu.get(read.remote(), timeout=60) == ("base", "job")
+        assert ray_tpu.get(override.remote(), timeout=60) == ("base", "task")
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_nested_task_inherits_env(local_cluster):
+    """A task submitted from inside an env'd task inherits that env
+    (reference: parent runtime_env inheritance)."""
+    @ray_tpu.remote(runtime_env={"env_vars": {"NEST": "deep"}})
+    def outer():
+        @ray_tpu.remote
+        def inner():
+            import os as _os
+
+            return _os.environ.get("NEST")
+
+        return ray_tpu.get(inner.remote(), timeout=60)
+
+    assert ray_tpu.get(outer.remote(), timeout=120) == "deep"
