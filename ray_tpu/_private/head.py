@@ -122,10 +122,11 @@ class _ActorEntry:
 class _NodeEntry:
     __slots__ = ("node_id", "host", "port", "arena_path", "resources",
                  "last_heartbeat", "client", "is_head_node",
-                 "pending_demands")
+                 "pending_demands", "labels")
 
     def __init__(self, node_id: str, host: str, port: int, arena_path: str,
-                 resources: NodeResources, is_head_node: bool):
+                 resources: NodeResources, is_head_node: bool,
+                 labels: Optional[Dict[str, str]] = None):
         self.node_id = node_id
         self.host = host
         self.port = port
@@ -137,6 +138,9 @@ class _NodeEntry:
         # queued + infeasible lease demands, piggybacked on heartbeats —
         # the autoscaler's scale-up signal (reference: load_metrics.py)
         self.pending_demands: List[Dict[str, float]] = []
+        # static key/value labels for NodeLabelSchedulingStrategy
+        # (reference: common.proto NodeLabels)
+        self.labels: Dict[str, str] = labels or {}
 
     def table_entry(self) -> Dict[str, Any]:
         return {
@@ -145,6 +149,7 @@ class _NodeEntry:
             "arena_path": self.arena_path,
             "resources": self.resources.to_dict(),
             "is_head_node": self.is_head_node,
+            "labels": self.labels,
         }
 
 
@@ -337,9 +342,12 @@ class HeadService(RpcHost):
 
     async def rpc_register_node(self, node_id: str, host: str, port: int,
                                 arena_path: str, resources: Dict[str, float],
-                                is_head_node: bool = False, _conn=None):
+                                is_head_node: bool = False,
+                                labels: Optional[Dict[str, str]] = None,
+                                _conn=None):
         entry = _NodeEntry(node_id, host, port, arena_path,
-                           NodeResources(ResourceSet(resources)), is_head_node)
+                           NodeResources(ResourceSet(resources)), is_head_node,
+                           labels=labels or {})
         self.nodes[node_id] = entry
         if _conn is not None:
             self._node_conns[_conn] = node_id
@@ -392,7 +400,8 @@ class HeadService(RpcHost):
 
     def _cluster_view(self) -> Dict[str, Any]:
         return {
-            nid: {"addr": [n.host, n.port], "res": n.resources.to_dict()}
+            nid: {"addr": [n.host, n.port], "res": n.resources.to_dict(),
+                  "labels": n.labels}
             for nid, n in self.nodes.items()
         }
 
@@ -630,14 +639,24 @@ class HeadService(RpcHost):
                 nid = pg.placements[max(ts.bundle_index, 0)]
             else:
                 cluster = {nid: n.resources for nid, n in self.nodes.items()}
-                nid = pick_node(cluster, demand, local_node_id="")
+                nid = pick_node(
+                    cluster, demand, local_node_id="",
+                    strategy=ts.scheduling_strategy,
+                    labels_by_node={nid: n.labels
+                                    for nid, n in self.nodes.items()})
             if nid is None:
-                if any(ResourceSet(s).fits(demand)
-                       for s in self._scalable_shapes()):
+                from ray_tpu._private.node_agent import _is_hard_strategy
+
+                if (not _is_hard_strategy(ts.scheduling_strategy)
+                        and any(ResourceSet(s).fits(demand)
+                                for s in self._scalable_shapes())):
                     # an autoscaler can launch a node this actor fits:
                     # keep the actor PENDING (visible via autoscaler_state)
                     # without spending the creation budget (reference:
-                    # pending actors resolve via the autoscaler demand loop)
+                    # pending actors resolve via the autoscaler demand
+                    # loop).  Hard affinity/label strategies are exempt —
+                    # scale-up can never mint the specific node they name,
+                    # so they burn the budget and die.
                     attempt -= 1
                 await asyncio.sleep(delay)
                 delay = min(delay * 2, 2.0)

@@ -97,6 +97,7 @@ def start_node_agent(session_dir: str, head_addr: Tuple[str, int],
                      object_store_memory: Optional[int] = None,
                      is_head_node: bool = False,
                      env: Optional[Dict[str, str]] = None,
+                     labels: Optional[Dict[str, str]] = None,
                      tag: str = "agent") -> Tuple[ProcessHandle, Dict[str, Any]]:
     from ray_tpu._private.spawn import fast_python_cmd
 
@@ -113,6 +114,8 @@ def start_node_agent(session_dir: str, head_addr: Tuple[str, int],
         argv += ["--capacity", str(object_store_memory)]
     if is_head_node:
         argv += ["--is-head-node"]
+    if labels:
+        argv += ["--labels", json.dumps(labels)]
     cmd, env_up = fast_python_cmd("ray_tpu._private.node_agent", argv)
     penv.update(env_up)
     proc = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,

@@ -62,6 +62,14 @@ class _Worker:
         self.idle_since = time.monotonic()
 
 
+def _is_hard_strategy(strategy: Dict[str, Any]) -> bool:
+    """Strategies pinned to specific existing nodes — unsatisfiable by
+    scale-up, so infeasibility is terminal (never parked)."""
+    stype = (strategy or {}).get("type", "")
+    return (stype == "node_label"
+            or (stype == "node_affinity" and not strategy.get("soft")))
+
+
 class _Lease:
     __slots__ = ("lease_id", "worker", "resources", "bundle_key")
 
@@ -77,11 +85,12 @@ class NodeAgent(RpcHost):
     def __init__(self, head_addr: Tuple[str, int], session_dir: str,
                  resources: Dict[str, float], arena_path: str = "",
                  capacity: int = 0, is_head_node: bool = False,
-                 node_id: str = ""):
+                 node_id: str = "", labels: Optional[Dict[str, str]] = None):
         self.node_id = node_id or NodeID.from_random().hex()
         self.head_addr = head_addr
         self.session_dir = session_dir
         self.is_head_node = is_head_node
+        self.labels: Dict[str, str] = labels or {}
         self.arena_path = arena_path or os.path.join(
             "/dev/shm", f"rt-arena-{self.node_id[:12]}")
         self.capacity = capacity or config.object_store_memory_bytes
@@ -133,7 +142,7 @@ class NodeAgent(RpcHost):
             "register_node", node_id=self.node_id, host=self.host,
             port=self.port, arena_path=self.arena_path,
             resources=self.resources.total.to_dict(),
-            is_head_node=self.is_head_node)
+            is_head_node=self.is_head_node, labels=self.labels)
         self._apply_cluster_view(reply.get("cluster"), reply.get("version"))
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
@@ -268,7 +277,7 @@ class NodeAgent(RpcHost):
                         host=self.host, port=self.port,
                         arena_path=self.arena_path,
                         resources=self.resources.total.to_dict(),
-                        is_head_node=self.is_head_node)
+                        is_head_node=self.is_head_node, labels=self.labels)
                 self._apply_cluster_view(reply.get("cluster"),
                                          reply.get("version"),
                                          reply.get("scalable"))
@@ -564,13 +573,21 @@ class NodeAgent(RpcHost):
             }
             # our own view is fresher than the gossiped one
             cluster[self.node_id] = self.resources
+            labels = {nid: v.get("labels", {})
+                      for nid, v in self.cluster_view.items()}
+            labels[self.node_id] = self.labels
             target = pick_node(
                 cluster, demand, self.node_id,
                 spread_threshold=config.scheduler_spread_threshold,
                 top_k_fraction=config.scheduler_top_k_fraction,
-                top_k_absolute=config.scheduler_top_k_absolute)
+                top_k_absolute=config.scheduler_top_k_absolute,
+                strategy=ts.scheduling_strategy, labels_by_node=labels)
             if target is None:
-                if self._demand_is_scalable(demand):
+                # hard affinity/label constraints name specific nodes;
+                # autoscaled capacity can never satisfy them, so they
+                # fail now instead of parking forever
+                if self._demand_is_scalable(demand) \
+                        and not _is_hard_strategy(ts.scheduling_strategy):
                     # an autoscaler can launch a node this fits: park the
                     # demand (visible to the scale-up loop via heartbeat)
                     # and tell the submitter to keep waiting — mirrors the
@@ -803,13 +820,15 @@ def main():
     ap.add_argument("--is-head-node", action="store_true")
     ap.add_argument("--port-file", default="")
     ap.add_argument("--node-id", default="")
+    ap.add_argument("--labels", default="{}")  # JSON dict
     args = ap.parse_args()
 
     async def run():
         agent = NodeAgent(
             (args.head_host, args.head_port), args.session_dir,
             json.loads(args.resources), capacity=args.capacity,
-            is_head_node=args.is_head_node, node_id=args.node_id)
+            is_head_node=args.is_head_node, node_id=args.node_id,
+            labels=json.loads(args.labels))
         port = await agent.start()
         if args.port_file:
             tmp = args.port_file + ".tmp"

@@ -75,22 +75,30 @@ def _package_dir(path: str) -> Tuple[str, bytes]:
 
 def _check_pip(requirements: List[str]) -> None:
     """No network in this image: accept requirements the base env already
-    satisfies, reject the rest loudly rather than failing at runtime."""
+    satisfies (name AND version specifier), reject the rest loudly
+    rather than failing at runtime."""
     import importlib.metadata as md
-    import re
+
+    from packaging.requirements import Requirement
 
     missing = []
     for req in requirements:
-        name = re.split(r"[<>=!~\[;]", req, 1)[0].strip()
-        if not name:
+        try:
+            parsed = Requirement(req)
+        except Exception:
+            missing.append(f"{req} (unparseable)")
             continue
         try:
-            md.version(name)
+            installed = md.version(parsed.name)
         except md.PackageNotFoundError:
             missing.append(req)
+            continue
+        if parsed.specifier and not parsed.specifier.contains(
+                installed, prereleases=True):
+            missing.append(f"{req} (installed: {installed})")
     if missing:
         raise RuntimeEnvError(
-            f"pip runtime_env cannot be satisfied offline; missing from "
+            f"pip runtime_env cannot be satisfied offline; unsatisfied in "
             f"the base environment: {missing}")
 
 
@@ -122,7 +130,9 @@ def normalize(renv: Dict[str, Any], head) -> Dict[str, Any]:
         for p in paths:
             sha, blob = _package_dir(p)
             kv_key = f"pkg:{sha}"
-            if head.call("kv_get", key=kv_key)["value"] is None:
+            # presence check via key listing — kv_get would ship the
+            # whole blob back just to discard it
+            if not head.call("kv_keys", prefix=kv_key)["keys"]:
                 head.call("kv_put", key=kv_key, value=blob, overwrite=True)
             shas.append(sha)
         out["pkg_py_modules" if many else "pkg_working_dir"] = \

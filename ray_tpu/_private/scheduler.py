@@ -91,6 +91,8 @@ def pick_node(
     top_k_fraction: float = 0.2,
     top_k_absolute: int = 5,
     rng: Optional[random.Random] = None,
+    strategy: Optional[Dict[str, object]] = None,
+    labels_by_node: Optional[Dict[str, Dict[str, str]]] = None,
 ) -> Optional[str]:
     """Hybrid policy: choose the node to send a lease request to.
 
@@ -101,8 +103,51 @@ def pick_node(
     3. Otherwise any node where the demand is *feasible* (total resources
        cover it) — the request queues there.
     4. None if infeasible everywhere (caller surfaces a scheduling error).
+
+    ``strategy`` overrides the hybrid default (reference:
+    scheduling_strategy.py + policies under raylet/scheduling/policy/):
+      {"type": "spread"}                     — least-utilized feasible
+        node, no local preference (spread_scheduling_policy.cc)
+      {"type": "node_affinity", "node_id", "soft"} — pin to one node;
+        hard pins never fall back (node_affinity_scheduling_policy.cc)
+      {"type": "node_label", "hard": {k: v}} — restrict to nodes whose
+        labels match, then run the default policy
+        (node_label_scheduling_policy.cc)
     """
     rng = rng or random
+    stype = (strategy or {}).get("type", "")
+    if stype == "node_affinity":
+        target = strategy.get("node_id", "")
+        node = cluster.get(target)
+        if node is not None and node.is_feasible(demand):
+            return target  # available now or queues there
+        if not strategy.get("soft"):
+            return None  # hard affinity: never reschedule elsewhere
+        # soft: fall through to the default policy
+    elif stype == "node_label":
+        labels_by_node = labels_by_node or {}
+        hard = strategy.get("hard") or {}
+        cluster = {
+            nid: nr for nid, nr in cluster.items()
+            if all(labels_by_node.get(nid, {}).get(k) == v
+                   for k, v in hard.items())
+        }
+        if not cluster:
+            return None
+    elif stype == "spread":
+        available = [(nid, nr) for nid, nr in cluster.items()
+                     if nr.can_fit(demand)]
+        if available:
+            low = min(nr.utilization() for _, nr in available)
+            best = [nid for nid, nr in available
+                    if nr.utilization() <= low + 1e-9]
+            return rng.choice(best)
+        feasible = [nid for nid, nr in cluster.items()
+                    if nr.is_feasible(demand)]
+        if feasible:
+            feasible.sort(key=lambda nid: cluster[nid].utilization())
+            return feasible[0]
+        return None
     local = cluster.get(local_node_id)
     if (local is not None and local.can_fit(demand)
             and local.utilization() < spread_threshold):

@@ -79,6 +79,9 @@ class TaskSpec:
     placement_group_id: str = ""
     bundle_index: int = -1
     runtime_env: Dict[str, Any] = field(default_factory=dict)
+    # {} | {"type": "spread"} | {"type": "node_affinity", ...} |
+    # {"type": "node_label", "hard": {...}} (see util/scheduling_strategies)
+    scheduling_strategy: Dict[str, Any] = field(default_factory=dict)
 
     def resource_set(self) -> ResourceSet:
         return ResourceSet(self.resources)
@@ -91,9 +94,12 @@ class TaskSpec:
         slow one into deep pipelining)."""
         from ray_tpu._private.runtime_env import env_key
 
+        import json
+
         return (ResourceSet(self.resources).key(), self.kind,
                 self.function_id, self.placement_group_id, self.bundle_index,
-                env_key(self.runtime_env))
+                env_key(self.runtime_env),
+                json.dumps(self.scheduling_strategy, sort_keys=True))
 
     def to_wire(self) -> Dict[str, Any]:
         return {
@@ -116,6 +122,7 @@ class TaskSpec:
             "pg": self.placement_group_id,
             "bundle": self.bundle_index,
             "renv": self.runtime_env,
+            "strat": self.scheduling_strategy,
         }
 
     @classmethod
@@ -141,4 +148,5 @@ class TaskSpec:
             placement_group_id=d.get("pg", ""),
             bundle_index=d.get("bundle", -1),
             runtime_env=d.get("renv", {}),
+            scheduling_strategy=d.get("strat", {}),
         )

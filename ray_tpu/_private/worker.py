@@ -811,6 +811,7 @@ class CoreWorker(RpcHost):
                     num_returns: int = 1, resources: Optional[Dict[str, float]] = None,
                     max_retries: int = 3, name: str = "",
                     runtime_env: Optional[Dict[str, Any]] = None,
+                    scheduling_strategy: Optional[Dict[str, Any]] = None,
                     placement_group_id: str = "",
                     bundle_index: int = -1) -> List[ObjectRef]:
         from ray_tpu._private.runtime_env import merge as _renv_merge
@@ -823,6 +824,7 @@ class CoreWorker(RpcHost):
             resources=resources or {"CPU": 1}, max_retries=max_retries,
             name=name, owner_addr=self.address, caller_id=self.worker_id,
             runtime_env=_renv_merge(self.job_runtime_env, runtime_env or {}),
+            scheduling_strategy=scheduling_strategy or {},
             placement_group_id=placement_group_id,
             bundle_index=max(bundle_index, 0) if placement_group_id else -1)
         task = _TaskState(spec, contained)
@@ -1205,6 +1207,7 @@ class CoreWorker(RpcHost):
                      max_restarts: int = 0, max_task_retries: int = 0,
                      max_concurrency: int = 1, name: str = "",
                      runtime_env: Optional[Dict[str, Any]] = None,
+                     scheduling_strategy: Optional[Dict[str, Any]] = None,
                      placement_group_id: str = "",
                      bundle_index: int = -1) -> str:
         from ray_tpu._private.runtime_env import merge as _renv_merge
@@ -1220,6 +1223,7 @@ class CoreWorker(RpcHost):
             max_retries=max_task_retries, name=name,
             owner_addr=self.address, caller_id=self.worker_id,
             runtime_env=_renv_merge(self.job_runtime_env, runtime_env or {}),
+            scheduling_strategy=scheduling_strategy or {},
             placement_group_id=placement_group_id,
             bundle_index=max(bundle_index, 0) if placement_group_id else -1)
         self.head.call("create_actor", spec=spec.to_wire(), name=name)

@@ -201,6 +201,7 @@ class RemoteFunction:
 
     _OPT_KEYS = ("num_returns", "num_cpus", "num_gpus", "num_tpus",
                  "resources", "max_retries", "name", "runtime_env",
+                 "scheduling_strategy",
                  "placement_group", "placement_group_bundle_index")
 
     def __init__(self, fn, **opts):
@@ -239,6 +240,7 @@ class RemoteFunction:
             self._fid(w), args, kwargs, num_returns=self._num_returns,
             resources=self._resources, max_retries=self._max_retries,
             name=self._name, runtime_env=_normalized_renv(self, w),
+            scheduling_strategy=_strategy_wire(self._opts),
             placement_group_id=pg.id if pg is not None else "",
             bundle_index=self._opts.get("placement_group_bundle_index", -1))
         if self._num_returns == 1:
@@ -256,6 +258,12 @@ class RemoteFunction:
         raise TypeError(
             f"Remote function {self._name} cannot be called directly; "
             f"use {self._name}.remote(...)")
+
+
+def _strategy_wire(opts: Dict[str, Any]) -> Dict[str, Any]:
+    from ray_tpu.util.scheduling_strategies import strategy_to_wire
+
+    return strategy_to_wire(opts.get("scheduling_strategy"))
 
 
 _renv_cache: Dict[tuple, Dict[str, Any]] = {}
@@ -371,7 +379,7 @@ class ActorHandle:
 class ActorClass:
     _OPT_KEYS = ("num_cpus", "num_gpus", "num_tpus", "resources",
                  "max_restarts", "max_task_retries", "max_concurrency",
-                 "name", "lifetime", "runtime_env",
+                 "name", "lifetime", "runtime_env", "scheduling_strategy",
                  "placement_group", "placement_group_bundle_index")
 
     def __init__(self, cls, **opts):
@@ -411,6 +419,7 @@ class ActorClass:
             max_task_retries=self._max_task_retries,
             max_concurrency=self._max_concurrency, name=self._name,
             runtime_env=_normalized_renv(self, w),
+            scheduling_strategy=_strategy_wire(self._opts),
             placement_group_id=pg.id if pg is not None else "",
             bundle_index=self._opts.get("placement_group_bundle_index", -1))
         owner = self._lifetime != "detached"

@@ -46,14 +46,15 @@ class Cluster:
     def add_node(self, num_cpus: float = 4,
                  resources: Optional[Dict[str, float]] = None,
                  object_store_memory: Optional[int] = None,
-                 is_head_node: bool = False) -> NodeHandle:
+                 is_head_node: bool = False,
+                 labels: Optional[Dict[str, str]] = None) -> NodeHandle:
         res: Dict[str, float] = {"CPU": float(num_cpus)}
         if resources:
             res.update(resources)
         proc, info = node_mod.start_node_agent(
             self.session_dir, self.head_addr, res,
             object_store_memory=object_store_memory,
-            is_head_node=is_head_node,
+            is_head_node=is_head_node, labels=labels,
             tag=f"agent-{len(self.nodes)}")
         handle = NodeHandle(proc, info)
         self.nodes.append(handle)

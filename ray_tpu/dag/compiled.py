@@ -82,8 +82,14 @@ class CompiledDAG:
             self._in_flight = [g for g in self._in_flight
                                if not all(r in done for r in g)]
         while len(self._in_flight) >= self._max_in_flight:
-            oldest = self._in_flight.pop(0)
+            oldest = self._in_flight[0]
             ray_tpu.wait(oldest, num_returns=len(oldest), timeout=300)
+            ready, _ = ray_tpu.wait(oldest, num_returns=len(oldest),
+                                    timeout=0)
+            if len(ready) == len(oldest):
+                self._in_flight.pop(0)
+            # else: stragglers past the wait timeout — keep the group so
+            # the cap stays real, and block again
 
     def teardown(self):
         """Kill the plan's actors."""

@@ -20,21 +20,81 @@ import numpy as np
 
 from ray_tpu.data.block import BlockAccessor, block_from_numpy, build_block
 
-# number of concurrently materializing block-tasks during iteration
-_STREAM_WINDOW = 8
+# streaming window bounds (resource-aware; see _stream_window)
+_WINDOW_MIN = 2
+_WINDOW_MAX = 64
+_window_cache = [0.0, 8]  # (expires_at, value)
+
+
+def _stream_window() -> int:
+    """Concurrent in-flight block-tasks during iteration, derived from
+    live cluster state instead of a fixed constant (reference:
+    _internal/execution/streaming_executor.py + backpressure_policy/ —
+    the reference sizes concurrency from resource budgets and pauses on
+    object-store pressure).
+
+    Window = 2 tasks per available CPU, halved when the local
+    object store is above 80% occupancy; clamped to [2, 64].
+    """
+    import time as _time
+
+    import ray_tpu
+
+    now = _time.monotonic()
+    if now < _window_cache[0]:
+        return _window_cache[1]
+    window = 8
+    try:
+        cpus = ray_tpu.cluster_resources().get("CPU", 4.0)
+        window = int(cpus * 2)
+        usage = ray_tpu.api._worker().agent.call(
+            "node_info", timeout=2.0)["store"]
+        if usage["capacity"] and usage["allocated"] / usage["capacity"] > 0.8:
+            window //= 2  # store pressure: stop outrunning consumption
+    except Exception:
+        pass
+    window = max(_WINDOW_MIN, min(_WINDOW_MAX, window))
+    _window_cache[0] = now + 0.5
+    _window_cache[1] = window
+    return window
 
 
 # --------------------------------------------------------------------- ops
 
 
+class ActorPoolStrategy:
+    """Run class-based UDFs on a pool of actors
+    (reference: python/ray/data/_internal/compute.py ActorPoolStrategy,
+    operators/actor_pool_map_operator.py)."""
+
+    def __init__(self, size: Optional[int] = None,
+                 min_size: Optional[int] = None,
+                 max_size: Optional[int] = None):
+        if size is not None:
+            min_size = max_size = size
+        self.min_size = min_size or 1
+        self.max_size = max_size or max(self.min_size, 4)
+        if self.min_size < 1 or self.max_size < self.min_size:
+            raise ValueError("invalid actor pool bounds")
+
+
 class _Op:
-    """One fusable per-block transform."""
+    """One fusable per-block transform.  For class UDFs (`is_actor`),
+    ``fn`` is the class; each pool actor instantiates it once and the
+    instance is called per batch."""
 
     def __init__(self, kind: str, fn: Optional[Callable] = None,
-                 batch_size: Optional[int] = None):
+                 batch_size: Optional[int] = None,
+                 is_actor: bool = False, ctor_args: tuple = (),
+                 ctor_kwargs: Optional[dict] = None,
+                 compute: Optional[ActorPoolStrategy] = None):
         self.kind = kind
         self.fn = fn
         self.batch_size = batch_size
+        self.is_actor = is_actor
+        self.ctor_args = ctor_args
+        self.ctor_kwargs = ctor_kwargs or {}
+        self.compute = compute
 
 
 def _apply_ops(block, ops: List[_Op]):
@@ -66,6 +126,22 @@ def _apply_ops(block, ops: List[_Op]):
 
 def _fused_block_task(block, ops: List[_Op]):
     return _apply_ops(block, ops)
+
+
+class _PoolMapWorker:
+    """Actor applying a fused op chain; class UDFs are instantiated once
+    per actor (reference: actor_pool_map_operator.py _MapWorker)."""
+
+    def __init__(self, ops: List[_Op]):
+        self.ops = []
+        for op in ops:
+            if op.is_actor:
+                inst = op.fn(*op.ctor_args, **op.ctor_kwargs)
+                op = _Op(op.kind, inst, op.batch_size)
+            self.ops.append(op)
+
+    def apply(self, block):
+        return _apply_ops(block, self.ops)
 
 
 def _shuffle_map(block, n_out: int, seed: int):
@@ -136,8 +212,26 @@ class Dataset:
         return Dataset(self._block_refs, self._ops + [op])
 
     def map_batches(self, fn: Callable[[Dict[str, np.ndarray]], Any],
-                    batch_size: Optional[int] = None) -> "Dataset":
-        return self._chain(_Op("map_batches", fn, batch_size))
+                    batch_size: Optional[int] = None,
+                    compute: Optional[ActorPoolStrategy] = None,
+                    concurrency: Optional[int] = None,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: Optional[dict] = None) -> "Dataset":
+        """Per-batch transform.  A class `fn` runs on an actor pool: each
+        actor constructs one instance (amortizing model loads) and calls
+        it per batch (reference: dataset.py map_batches :371 +
+        actor_pool_map_operator.py)."""
+        is_cls = isinstance(fn, type)
+        if is_cls and compute is None:
+            compute = ActorPoolStrategy(size=concurrency) if concurrency \
+                else ActorPoolStrategy()
+        if not is_cls and (compute or fn_constructor_args
+                           or fn_constructor_kwargs):
+            raise ValueError("compute/fn_constructor_* require a class UDF")
+        return self._chain(_Op(
+            "map_batches", fn, batch_size, is_actor=is_cls,
+            ctor_args=tuple(fn_constructor_args),
+            ctor_kwargs=fn_constructor_kwargs, compute=compute))
 
     def map(self, fn: Callable[[dict], dict]) -> "Dataset":
         return self._chain(_Op("map", fn))
@@ -159,10 +253,37 @@ class Dataset:
         fn = _remote_fused()
         return fn.remote(ref, self._ops)
 
+    def _has_actor_op(self) -> bool:
+        return any(op.is_actor for op in self._ops)
+
+    def _make_pool(self) -> List[Any]:
+        """Actors for the chain's class UDFs, sized to the workload
+        within the strategy's [min_size, max_size]."""
+        import ray_tpu
+
+        compute = next((op.compute for op in self._ops
+                        if op.is_actor and op.compute), None) \
+            or ActorPoolStrategy()
+        n = min(compute.max_size,
+                max(compute.min_size, len(self._block_refs)))
+        cls = ray_tpu.remote(_PoolMapWorker)
+        return [cls.remote(self._ops) for _ in builtins.range(n)]
+
     def _execute(self) -> List[Any]:
         if self._materialized is None:
-            self._materialized = [self._submit_block(r)
-                                  for r in self._block_refs]
+            if self._has_actor_op():
+                import weakref
+
+                actors = self._make_pool()
+                refs = [actors[i % len(actors)].apply.remote(r)
+                        for i, r in enumerate(self._block_refs)]
+                # the pool must outlive its in-flight results
+                for ref in refs:
+                    weakref.finalize(ref, lambda _h: None, tuple(actors))
+                self._materialized = refs
+            else:
+                self._materialized = [self._submit_block(r)
+                                      for r in self._block_refs]
         return self._materialized
 
     def materialize(self) -> "Dataset":
@@ -178,7 +299,8 @@ class Dataset:
     # ---- consumption ----
 
     def iter_blocks(self) -> Iterator[Any]:
-        """Stream result blocks with a bounded in-flight window
+        """Stream result blocks with a bounded in-flight window sized
+        from live cluster resources and store occupancy
         (reference: streaming executor backpressure)."""
         import ray_tpu
 
@@ -188,8 +310,20 @@ class Dataset:
             return
         pending = list(self._block_refs)
         in_flight: List[Any] = []
+        if self._has_actor_op():
+            actors = self._make_pool()
+            rr = 0
+            while pending or in_flight:
+                # ≤2 queued per actor keeps the pool busy without
+                # flooding any single replica's mailbox
+                while pending and len(in_flight) < 2 * len(actors):
+                    in_flight.append(
+                        actors[rr % len(actors)].apply.remote(pending.pop(0)))
+                    rr += 1
+                yield ray_tpu.get(in_flight.pop(0), timeout=600)
+            return
         while pending or in_flight:
-            while pending and len(in_flight) < _STREAM_WINDOW:
+            while pending and len(in_flight) < _stream_window():
                 in_flight.append(self._submit_block(pending.pop(0)))
             ref = in_flight.pop(0)
             yield ray_tpu.get(ref, timeout=600)

@@ -3,6 +3,9 @@
 from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.placement_group import (PlacementGroup, placement_group,
                                           remove_placement_group)
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy, NodeLabelSchedulingStrategy)
 
 __all__ = ["ActorPool", "PlacementGroup", "placement_group",
-           "remove_placement_group"]
+           "remove_placement_group", "NodeAffinitySchedulingStrategy",
+           "NodeLabelSchedulingStrategy"]

@@ -107,3 +107,49 @@ def test_tensor_columns(cluster):
     batch = next(ds.iter_batches(batch_size=20))
     assert batch["feat"].shape == (20, 8)
     np.testing.assert_allclose(batch["feat"], arr)
+
+
+def test_map_batches_actor_pool(cluster):
+    """Class UDFs run on an actor pool; the instance is constructed once
+    per actor and reused across batches (reference:
+    actor_pool_map_operator.py)."""
+    from ray_tpu import data
+    from ray_tpu.data import ActorPoolStrategy
+
+    class AddBase:
+        def __init__(self, base):
+            import os
+
+            self.base = base
+            self.pid = os.getpid()
+            self.calls = 0
+
+        def __call__(self, batch):
+            self.calls += 1
+            return {"id": batch["id"] + self.base, "pid":
+                    __import__("numpy").full(len(batch["id"]), self.pid)}
+
+    ds = data.range(40, num_blocks=8).map_batches(
+        AddBase, compute=ActorPoolStrategy(size=2),
+        fn_constructor_args=(100,))
+    rows = ds.take_all()
+    assert sorted(r["id"] for r in rows) == list(range(100, 140))
+    # exactly 2 pool actors served all 8 blocks
+    assert len({r["pid"] for r in rows}) == 2
+
+
+def test_map_batches_class_requires_no_fn_args_for_plain_fn(cluster):
+    from ray_tpu import data
+
+    with pytest.raises(ValueError):
+        data.range(4).map_batches(lambda b: b, fn_constructor_args=(1,))
+
+
+def test_stream_window_is_resource_aware(cluster):
+    from ray_tpu.data import dataset as ds_mod
+
+    ds_mod._window_cache[0] = 0.0  # drop the TTL cache
+    w = ds_mod._stream_window()
+    assert ds_mod._WINDOW_MIN <= w <= ds_mod._WINDOW_MAX
+    # 4-CPU test cluster: 2 tasks per CPU
+    assert w == 8

@@ -1,0 +1,138 @@
+"""SPREAD / node-affinity / node-label scheduling tests
+(reference: python/ray/tests/test_scheduling_2.py strategy coverage,
+raylet/scheduling/policy tests)."""
+
+import collections
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.resources import NodeResources, ResourceSet
+from ray_tpu._private.scheduler import pick_node
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import (NodeAffinitySchedulingStrategy,
+                          NodeLabelSchedulingStrategy)
+
+
+def _nr(cpu_total, cpu_used=0.0):
+    nr = NodeResources(ResourceSet({"CPU": cpu_total}))
+    if cpu_used:
+        nr.acquire(ResourceSet({"CPU": cpu_used}))
+    return nr
+
+
+# ---------------------------------------------------------------- unit
+
+
+def test_pick_node_spread_prefers_least_utilized():
+    cluster = {"a": _nr(4, 3), "b": _nr(4, 0), "c": _nr(4, 2)}
+    demand = ResourceSet({"CPU": 1})
+    picks = {pick_node(cluster, demand, "a", strategy={"type": "spread"})
+             for _ in range(10)}
+    assert picks == {"b"}
+
+
+def test_pick_node_affinity_hard_and_soft():
+    cluster = {"a": _nr(4), "b": _nr(4)}
+    demand = ResourceSet({"CPU": 1})
+    strat = {"type": "node_affinity", "node_id": "b", "soft": False}
+    assert pick_node(cluster, demand, "a", strategy=strat) == "b"
+    # hard affinity to an unknown node: never falls back
+    strat = {"type": "node_affinity", "node_id": "zz", "soft": False}
+    assert pick_node(cluster, demand, "a", strategy=strat) is None
+    # soft affinity falls back to the default policy
+    strat = {"type": "node_affinity", "node_id": "zz", "soft": True}
+    assert pick_node(cluster, demand, "a", strategy=strat) in ("a", "b")
+
+
+def test_pick_node_labels():
+    cluster = {"a": _nr(4), "b": _nr(4)}
+    labels = {"a": {"zone": "us-1"}, "b": {"zone": "eu-2"}}
+    demand = ResourceSet({"CPU": 1})
+    strat = {"type": "node_label", "hard": {"zone": "eu-2"}}
+    assert pick_node(cluster, demand, "a", strategy=strat,
+                     labels_by_node=labels) == "b"
+    strat = {"type": "node_label", "hard": {"zone": "mars"}}
+    assert pick_node(cluster, demand, "a", strategy=strat,
+                     labels_by_node=labels) is None
+
+
+# ------------------------------------------------------------ end-to-end
+
+
+@pytest.fixture(scope="module")
+def two_node():
+    cluster = Cluster(head_node_args={"num_cpus": 4})
+    cluster.add_node(num_cpus=4, labels={"tier": "accel"})
+    ray_tpu.init(address=cluster.address)
+    cluster.wait_for_nodes(2)
+    try:
+        yield cluster
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_spread_uses_both_nodes(two_node):
+    @ray_tpu.remote(scheduling_strategy="SPREAD", num_cpus=1)
+    def where():
+        import os as _os
+
+        return _os.environ["RT_NODE_ID"]
+
+    import time as _t
+
+    nodes = set()
+    deadline = _t.time() + 60
+    while len(nodes) < 2 and _t.time() < deadline:
+        nodes |= set(ray_tpu.get([where.remote() for _ in range(8)],
+                                 timeout=60))
+    assert len(nodes) == 2
+
+
+def test_node_affinity_task_and_actor(two_node):
+    target = two_node.nodes[1].node_id
+
+    @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        target), num_cpus=1)
+    def where():
+        import os as _os
+
+        return _os.environ["RT_NODE_ID"]
+
+    got = ray_tpu.get([where.remote() for _ in range(4)], timeout=60)
+    assert set(got) == {target}
+
+    @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        target))
+    class Pinned:
+        def where(self):
+            import os as _os
+
+            return _os.environ["RT_NODE_ID"]
+
+    a = Pinned.remote()
+    assert ray_tpu.get(a.where.remote(), timeout=60) == target
+
+
+def test_node_label_strategy(two_node):
+    labeled = two_node.nodes[1].node_id
+
+    @ray_tpu.remote(scheduling_strategy=NodeLabelSchedulingStrategy(
+        hard={"tier": "accel"}), num_cpus=1)
+    def where():
+        import os as _os
+
+        return _os.environ["RT_NODE_ID"]
+
+    assert ray_tpu.get(where.remote(), timeout=60) == labeled
+
+
+def test_hard_affinity_to_dead_node_fails(two_node):
+    @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        "0" * 56), num_cpus=1, max_retries=0)
+    def f():
+        return 1
+
+    with pytest.raises(ray_tpu.RayError):
+        ray_tpu.get(f.remote(), timeout=60)
