@@ -1,0 +1,111 @@
+/* Native hot-path helpers for the shared-memory object store.
+ *
+ * Equivalent of the reference's C++ plasma client copy path
+ * (reference: src/ray/object_manager/plasma/client.cc — WriteObject
+ * uses multithreaded memcpy for large objects; ray_config_def.h
+ * object_store_memcpy_threads).  A single-threaded Python memoryview
+ * copy tops out around 4.6 GB/s on this host; splitting the copy
+ * across threads reaches ~8 GB/s, and read-touching fresh PTEs in
+ * parallel removes most page-fault stalls.
+ *
+ * Built at first import by ray_tpu/_native/__init__.py:
+ *   cc -O3 -shared -fPIC -pthread copyfast.c -o <cache>/copyfast.so
+ */
+
+#include <pthread.h>
+#include <stddef.h>
+#include <string.h>
+
+typedef struct {
+    char *dst;
+    const char *src;
+    size_t n;
+} copy_job_t;
+
+static void *copy_run(void *arg) {
+    copy_job_t *j = (copy_job_t *)arg;
+    memcpy(j->dst, j->src, j->n);
+    return 0;
+}
+
+/* Copy n bytes using up to nthreads threads (page-aligned chunks).
+ * Small copies stay single-threaded: thread spawn costs ~30us. */
+void parallel_copy(char *dst, const char *src, size_t n, int nthreads) {
+    if (nthreads < 2 || n < (size_t)(1 << 21)) {
+        memcpy(dst, src, n);
+        return;
+    }
+    if (nthreads > 64)
+        nthreads = 64;
+    pthread_t threads[64];
+    copy_job_t jobs[64];
+    size_t chunk = (n + (size_t)nthreads - 1) / (size_t)nthreads;
+    chunk = (chunk + 4095) & ~(size_t)4095;
+    int started = 0;
+    for (int i = 0; i < nthreads; i++) {
+        size_t off = (size_t)i * chunk;
+        if (off >= n)
+            break;
+        size_t len = n - off < chunk ? n - off : chunk;
+        jobs[started].dst = dst + off;
+        jobs[started].src = src + off;
+        jobs[started].n = len;
+        if (pthread_create(&threads[started], 0, copy_run,
+                           &jobs[started]) != 0) {
+            /* thread spawn failed: finish inline */
+            memcpy(dst + off, src + off, n - off);
+            break;
+        }
+        started++;
+    }
+    for (int i = 0; i < started; i++)
+        pthread_join(threads[i], 0);
+}
+
+typedef struct {
+    const volatile char *p;
+    size_t n;
+} touch_job_t;
+
+static void *touch_run(void *arg) {
+    touch_job_t *j = (touch_job_t *)arg;
+    volatile char sink = 0;
+    for (size_t off = 0; off < j->n; off += 4096)
+        sink ^= j->p[off];
+    (void)sink;
+    return 0;
+}
+
+/* Read-fault one byte per page so a following write runs at memcpy
+ * speed instead of write-fault speed (PTE setup for already-resident
+ * tmpfs pages). */
+void parallel_touch(const char *p, size_t n, int nthreads) {
+    if (nthreads < 2 || n < (size_t)(1 << 22)) {
+        touch_job_t j = {p, n};
+        touch_run(&j);
+        return;
+    }
+    if (nthreads > 64)
+        nthreads = 64;
+    pthread_t threads[64];
+    touch_job_t jobs[64];
+    size_t chunk = (n + (size_t)nthreads - 1) / (size_t)nthreads;
+    chunk = (chunk + 4095) & ~(size_t)4095;
+    int started = 0;
+    for (int i = 0; i < nthreads; i++) {
+        size_t off = (size_t)i * chunk;
+        if (off >= n)
+            break;
+        jobs[started].p = p + off;
+        jobs[started].n = n - off < chunk ? n - off : chunk;
+        if (pthread_create(&threads[started], 0, touch_run,
+                           &jobs[started]) != 0) {
+            touch_job_t j = {p + off, n - off};
+            touch_run(&j);
+            break;
+        }
+        started++;
+    }
+    for (int i = 0; i < started; i++)
+        pthread_join(threads[i], 0);
+}

@@ -50,6 +50,12 @@ _def("rpc_call_timeout_s", 120.0)
 # --- workers ----------------------------------------------------------------
 _def("worker_register_timeout_s", 30.0)
 _def("worker_startup_parallelism", 4)
+# --- memory monitor (reference: memory_monitor.h:52 + ray_config_def.h
+# memory_usage_threshold / memory_monitor_refresh_ms) -------------------------
+_def("memory_usage_threshold", 0.95)          # node memory fraction
+_def("memory_monitor_refresh_ms", 250)        # 0 disables the monitor
+_def("memory_monitor_min_kill_interval_ms", 1_000)
+_def("memory_monitor_test_usage_file", "")    # test hook: fraction in a file
 # --- observability ----------------------------------------------------------
 _def("task_events_buffer_size", 10_000)
 _def("metrics_report_interval_ms", 5_000)

@@ -71,14 +71,15 @@ def _is_hard_strategy(strategy: Dict[str, Any]) -> bool:
 
 
 class _Lease:
-    __slots__ = ("lease_id", "worker", "resources", "bundle_key")
+    __slots__ = ("lease_id", "worker", "resources", "bundle_key", "seq")
 
     def __init__(self, lease_id: str, worker: _Worker, resources: ResourceSet,
-                 bundle_key: str = ""):
+                 bundle_key: str = "", seq: int = 0):
         self.lease_id = lease_id
         self.worker = worker
         self.resources = resources
         self.bundle_key = bundle_key
+        self.seq = seq  # grant order; the OOM policy kills newest first
 
 
 class NodeAgent(RpcHost):
@@ -146,6 +147,9 @@ class NodeAgent(RpcHost):
         self._apply_cluster_view(reply.get("cluster"), reply.get("version"))
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        if config.memory_monitor_refresh_ms > 0:
+            self._tasks.append(
+                asyncio.ensure_future(self._memory_monitor_loop()))
         await self._start_metrics(host)
         for _ in range(config.worker_pool_prestart_workers):
             self._spawn_worker()
@@ -500,6 +504,73 @@ class NodeAgent(RpcHost):
             asyncio.ensure_future(self._report_worker_death(worker_id, reason))
         self._drain_lease_queue()
 
+    # ---- memory monitor ----------------------------------------------------
+
+    def _memory_usage_fraction(self) -> Optional[float]:
+        """Node memory pressure in [0, 1]; None if unreadable.
+        The test hook file (memory_monitor_test_usage_file) overrides the
+        /proc/meminfo reading so OOM behavior is testable without
+        actually exhausting the host."""
+        test_file = config.memory_monitor_test_usage_file
+        if test_file:
+            try:
+                with open(test_file) as f:
+                    return float(f.read().strip())
+            except (OSError, ValueError):
+                return None
+        try:
+            fields = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    key, _, rest = line.partition(":")
+                    fields[key] = int(rest.split()[0])
+            total = fields.get("MemTotal", 0)
+            avail = fields.get("MemAvailable", fields.get("MemFree", 0))
+            if total <= 0:
+                return None
+            return 1.0 - avail / total
+        except (OSError, ValueError):
+            return None
+
+    def _pick_oom_victim(self) -> Optional[_Worker]:
+        """Newest-leased worker first (reference: memory_monitor.h policy
+        via worker_killing_policy.cc — kill the task submitted last, so
+        long-running earlier work keeps its progress)."""
+        for lease in sorted(self._leases.values(),
+                            key=lambda l: l.seq, reverse=True):
+            w = lease.worker
+            if w.proc.poll() is None:
+                return w
+        return None
+
+    async def _memory_monitor_loop(self):
+        """Kill workers when node memory crosses the threshold, newest
+        lease first; the owner's normal worker-death retry resubmits the
+        task once pressure clears (reference: memory_monitor.h:52)."""
+        period = config.memory_monitor_refresh_ms / 1000.0
+        min_gap = config.memory_monitor_min_kill_interval_ms / 1000.0
+        last_kill = 0.0
+        while True:
+            await asyncio.sleep(period)
+            usage = self._memory_usage_fraction()
+            threshold = config.memory_usage_threshold
+            if usage is None or usage < threshold:
+                continue
+            if time.monotonic() - last_kill < min_gap:
+                continue  # let the last kill take effect before another
+            victim = self._pick_oom_victim()
+            if victim is None:
+                continue
+            last_kill = time.monotonic()
+            reason = (f"OOM-killed by the memory monitor: node memory "
+                      f"{usage:.0%} >= threshold {threshold:.0%} "
+                      f"(newest-lease-first policy)")
+            try:
+                victim.proc.kill()
+            except Exception:
+                pass
+            self._on_worker_dead(victim.worker_id, reason)
+
     async def _report_worker_death(self, worker_id: str, reason: str):
         try:
             await self._head.call("worker_died", node_id=self.node_id,
@@ -695,7 +766,8 @@ class NodeAgent(RpcHost):
                     "error_str": "could not start a worker process"}
         self._lease_counter += 1
         lease_id = f"{self.node_id[:12]}-{self._lease_counter}"
-        lease = _Lease(lease_id, worker, demand, bundle_key)
+        lease = _Lease(lease_id, worker, demand, bundle_key,
+                       seq=self._lease_counter)
         worker.lease_id = lease_id
         self._leases[lease_id] = lease
         return {"granted": {

@@ -62,7 +62,14 @@ def packed_size(frames: List[memoryview]) -> int:
 
 
 def pack_into(frames: List[memoryview], out: memoryview) -> int:
-    """Pack frames into a pre-allocated buffer (e.g. a plasma allocation)."""
+    """Pack frames into a pre-allocated buffer (e.g. a plasma allocation).
+
+    Large frames (numpy/jax host buffers) copy via the native
+    multithreaded memcpy when available — the single-threaded Python
+    slice copy caps put bandwidth at ~4.6 GB/s on this host
+    (reference: plasma client.cc multithreaded WriteObject)."""
+    from ray_tpu import _native
+
     n = len(frames)
     out[0:4] = _MAGIC.to_bytes(4, "little")
     out[4:8] = n.to_bytes(4, "little")
@@ -72,7 +79,10 @@ def pack_into(frames: List[memoryview], out: memoryview) -> int:
         pos += 8
     for f in frames:
         pos = _aligned(pos)
-        out[pos : pos + f.nbytes] = f
+        if f.nbytes >= (1 << 21):
+            _native.copy_into(out[pos : pos + f.nbytes], f)
+        else:
+            out[pos : pos + f.nbytes] = f
         pos += f.nbytes
     return pos
 

@@ -1,0 +1,81 @@
+"""Memory monitor / OOM protection tests
+(reference: src/ray/raylet/worker_killing_policy.cc +
+python/ray/tests/test_memory_pressure.py — via the test-usage-file hook
+so no real memory is exhausted)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def oom_cluster(tmp_path):
+    usage_file = str(tmp_path / "usage")
+    with open(usage_file, "w") as f:
+        f.write("0.10")
+    ray_tpu.init(
+        num_cpus=2, object_store_memory=64 * 1024 * 1024,
+        _system_config={
+            "memory_monitor_test_usage_file": usage_file,
+            "memory_usage_threshold": 0.9,
+            "memory_monitor_refresh_ms": 100,
+            "memory_monitor_min_kill_interval_ms": 200,
+        })
+    try:
+        yield usage_file
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_oom_kill_retries_task(oom_cluster, tmp_path):
+    usage_file = oom_cluster
+    attempts = str(tmp_path / "attempts")
+
+    @ray_tpu.remote(max_retries=2)
+    def hog():
+        with open(attempts, "a") as f:
+            f.write("x\n")
+        n = len(open(attempts).readlines())
+        if n == 1:
+            time.sleep(120)  # parked until the monitor kills this worker
+        return n
+
+    ref = hog.remote()
+    deadline = time.time() + 30
+    while not os.path.exists(attempts) and time.time() < deadline:
+        time.sleep(0.1)
+    assert os.path.exists(attempts), "task never started"
+    with open(usage_file, "w") as f:
+        f.write("0.99")  # cross the threshold: newest lease is killed
+    # give the monitor time to kill, then clear the pressure
+    deadline = time.time() + 30
+    while len(open(attempts).readlines()) < 2 and time.time() < deadline:
+        time.sleep(0.2)
+    with open(usage_file, "w") as f:
+        f.write("0.10")
+    assert ray_tpu.get(ref, timeout=60) == 2  # retried after the OOM kill
+
+
+def test_oom_kill_exhausts_retries(oom_cluster, tmp_path):
+    usage_file = oom_cluster
+    started = str(tmp_path / "started")
+
+    @ray_tpu.remote(max_retries=0)
+    def hog():
+        open(started, "w").close()
+        time.sleep(120)
+        return 1
+
+    ref = hog.remote()
+    deadline = time.time() + 30
+    while not os.path.exists(started) and time.time() < deadline:
+        time.sleep(0.1)
+    with open(usage_file, "w") as f:
+        f.write("0.99")
+    with pytest.raises(ray_tpu.RayError):
+        ray_tpu.get(ref, timeout=60)
+    with open(usage_file, "w") as f:
+        f.write("0.10")
