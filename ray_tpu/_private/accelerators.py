@@ -30,8 +30,35 @@ def num_tpu_chips() -> int:
     return len(devices)
 
 
+_metadata_cache: Dict[str, Optional[str]] = {}
+
+
+def _gce_metadata_http(key: str) -> Optional[str]:
+    """GCE/GKE metadata-server lookup (reference: tpu.py:52
+    _get_tpu_metadata — GKE TPU pods expose accelerator-type and
+    agent-worker-number through the instance metadata server).  Cached;
+    fails fast off-GCP."""
+    if key in _metadata_cache:
+        return _metadata_cache[key]
+    value: Optional[str] = None
+    try:
+        import urllib.request
+
+        req = urllib.request.Request(
+            "http://metadata.google.internal/computeMetadata/v1/"
+            f"instance/attributes/{key}",
+            headers={"Metadata-Flavor": "Google"})
+        with urllib.request.urlopen(req, timeout=0.5) as r:
+            value = r.read().decode().strip()
+    except Exception:
+        value = None
+    _metadata_cache[key] = value
+    return value
+
+
 def tpu_metadata(key: str) -> Optional[str]:
-    """GCE metadata lookup; returns None off-GCP (zero egress tolerated)."""
+    """TPU slice metadata: env vars first (GKE injects them; tests set
+    them), then the GCE metadata server, else None."""
     env_map = {
         "accelerator-type": "TPU_ACCELERATOR_TYPE",
         "agent-worker-number": "TPU_WORKER_ID",
@@ -40,7 +67,19 @@ def tpu_metadata(key: str) -> Optional[str]:
     env = env_map.get(key)
     if env and os.environ.get(env) is not None:
         return os.environ.get(env)
-    return None
+    if os.environ.get("RT_DISABLE_METADATA_SERVER") or not _on_gce():
+        return None  # off-GCP: keep the zero-egress guarantee
+    return _gce_metadata_http(key)
+
+
+def _on_gce() -> bool:
+    """Detect GCE/GKE via DMI — no network, so off-GCP hosts never pay
+    a DNS stall for metadata.google.internal."""
+    try:
+        with open("/sys/class/dmi/id/product_name") as f:
+            return "Google" in f.read()
+    except OSError:
+        return False
 
 
 def detect_accelerators() -> Dict[str, float]:

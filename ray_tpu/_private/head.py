@@ -167,6 +167,12 @@ class HeadService(RpcHost):
         self._node_conns: Dict[Any, str] = {}  # conn -> node_id
         self._cluster_version = 0  # bumped on membership change
         self._shutdown = asyncio.Event()
+        # general pub/sub: per-channel ring buffer + long-poll waiters
+        # (reference: pubsub/publisher.h:307 — typed channels for node
+        # events, actor state, errors; here any named channel works)
+        self._pubsub: Dict[str, Any] = {}        # channel -> deque[(seq, payload)]
+        self._pubsub_seq: Dict[str, int] = {}
+        self._pubsub_waiters: Dict[str, List[asyncio.Event]] = {}
         # persistence (reference: gcs/store_client/redis_store_client.h —
         # GCS tables behind a store so the head survives restarts; we
         # snapshot to a local file, atomic tmp+rename)
@@ -351,6 +357,10 @@ class HeadService(RpcHost):
         self.nodes[node_id] = entry
         if _conn is not None:
             self._node_conns[_conn] = node_id
+        self.publish("node_events", {"event": "registered",
+                                     "node_id": node_id,
+                                     "addr": [host, port],
+                                     "is_head_node": is_head_node})
         self._cluster_version += 1
         self.mark_dirty()
         self._broadcast_cluster_view()
@@ -393,6 +403,55 @@ class HeadService(RpcHost):
     async def rpc_node_table(self):
         return {nid: n.table_entry() for nid, n in self.nodes.items()}
 
+    # ---- pub/sub -----------------------------------------------------------
+
+    def publish(self, channel: str, payload: Any) -> int:
+        """Append an event to a channel's ring buffer and wake pollers
+        (reference: pubsub/publisher.h Publish)."""
+        from collections import deque
+
+        seq = self._pubsub_seq.get(channel, 0) + 1
+        self._pubsub_seq[channel] = seq
+        buf = self._pubsub.get(channel)
+        if buf is None:
+            buf = self._pubsub[channel] = deque(maxlen=1000)
+        buf.append((seq, payload))
+        for ev in self._pubsub_waiters.pop(channel, []):
+            ev.set()
+        return seq
+
+    async def rpc_publish(self, channel: str, payload: Any):
+        return {"seq": self.publish(channel, payload)}
+
+    async def rpc_subscribe_poll(self, channel: str, after_seq: int = 0,
+                                 timeout_ms: int = 0):
+        """Long-poll: events with seq > after_seq, waiting up to
+        timeout_ms when none are buffered yet (reference: the
+        subscriber's long-poll loop in pubsub/subscriber.h)."""
+        # 0 means "return immediately"; positive values are clamped
+        timeout_ms = min(timeout_ms, config.pubsub_poll_timeout_ms) \
+            if timeout_ms > 0 else 0
+
+        def collect():
+            buf = self._pubsub.get(channel) or ()
+            return [{"seq": s, "payload": p} for s, p in buf if s > after_seq]
+
+        events = collect()
+        if not events and timeout_ms > 0:
+            ev = asyncio.Event()
+            self._pubsub_waiters.setdefault(channel, []).append(ev)
+            try:
+                await asyncio.wait_for(ev.wait(), timeout_ms / 1000.0)
+            except asyncio.TimeoutError:
+                pass
+            finally:
+                waiters = self._pubsub_waiters.get(channel, [])
+                if ev in waiters:
+                    waiters.remove(ev)
+            events = collect()
+        return {"events": events,
+                "latest_seq": self._pubsub_seq.get(channel, 0)}
+
     async def rpc_drain_node(self, node_id: str):
         """Graceful removal (reference: node_manager.proto DrainRaylet)."""
         await self._on_node_dead(node_id, "drained")
@@ -427,6 +486,8 @@ class HeadService(RpcHost):
             return
         self._cluster_version += 1
         self.mark_dirty()
+        self.publish("node_events", {"event": "dead", "node_id": node_id,
+                                     "reason": reason})
         self._broadcast_cluster_view()
         if entry.client is not None:
             await entry.client.close()
@@ -553,6 +614,9 @@ class HeadService(RpcHost):
 
     async def rpc_worker_died(self, node_id: str, worker_id: str, reason: str = ""):
         """Node agent reports a worker process death."""
+        self.publish("error_info", {"kind": "worker_died",
+                                    "node_id": node_id,
+                                    "worker_id": worker_id, "reason": reason})
         for actor in list(self.actors.values()):
             if actor.worker_id == worker_id and actor.state in (ALIVE, PENDING):
                 await self._on_actor_worker_lost(
@@ -571,11 +635,17 @@ class HeadService(RpcHost):
             actor.death_cause = cause
             if actor.name:
                 self.named_actors.pop(actor.name, None)
+            self.publish("actor_events", {
+                "actor_id": actor.actor_id, "state": "DEAD",
+                "name": actor.name, "cause": cause})
             actor.wake()
             return
         if actor.restarts_left > 0:
             actor.restarts_left -= 1
         actor.state = RESTARTING
+        self.publish("actor_events", {
+            "actor_id": actor.actor_id, "state": "RESTARTING",
+            "name": actor.name, "cause": cause})
         actor.wake()
         self._spawn_scheduler(actor)
 
@@ -701,6 +771,7 @@ class HeadService(RpcHost):
             try:
                 reply = await wclient.call(
                     "push_task", spec=actor.spec_wire, instance=actor.instance + 1,
+                    tpu_chips=g.get("tpu_chips"),
                     timeout=7 * 86400.0)
                 if reply.get("error"):
                     raise RpcError(f"actor constructor failed: {reply['error_str']}")
@@ -755,6 +826,9 @@ class HeadService(RpcHost):
             actor.worker_id = g["worker_id"]
             actor.addr = (g["addr"][0], g["addr"][1])
             self.mark_dirty()
+            self.publish("actor_events", {
+                "actor_id": actor.actor_id, "state": "ALIVE",
+                "name": actor.name, "node_id": nid})
             actor.wake()
             return
         actor.state = DEAD

@@ -71,7 +71,8 @@ def _is_hard_strategy(strategy: Dict[str, Any]) -> bool:
 
 
 class _Lease:
-    __slots__ = ("lease_id", "worker", "resources", "bundle_key", "seq")
+    __slots__ = ("lease_id", "worker", "resources", "bundle_key", "seq",
+                 "tpu_chips")
 
     def __init__(self, lease_id: str, worker: _Worker, resources: ResourceSet,
                  bundle_key: str = "", seq: int = 0):
@@ -80,6 +81,7 @@ class _Lease:
         self.resources = resources
         self.bundle_key = bundle_key
         self.seq = seq  # grant order; the OOM policy kills newest first
+        self.tpu_chips: List[int] = []  # chip indices assigned to this lease
 
 
 class NodeAgent(RpcHost):
@@ -103,6 +105,12 @@ class NodeAgent(RpcHost):
         resources = dict(resources)
         resources.setdefault(f"node:{self.node_id[:12]}", 1.0)
         self.resources = NodeResources(ResourceSet(resources))
+        # concrete chip indices behind the fungible "TPU" count: leases
+        # holding TPU resources get specific chips, exported to the task
+        # as TPU_VISIBLE_CHIPS (reference: accelerators/tpu.py:30
+        # set_current_process_visible_accelerator_ids)
+        self._free_tpu_chips: List[int] = list(
+            range(int(resources.get("TPU", 0))))
         self.local = LocalScheduler(self.resources)
         # placement-group bundles reserved on this node: "pgid:idx" ->
         # LocalScheduler over the reserved resources (reference:
@@ -316,6 +324,28 @@ class NodeAgent(RpcHost):
     async def rpc_store_contains(self, oid: str):
         return self.store.contains(oid)
 
+    async def rpc_store_write(self, oid: str, offset: int, data: bytes):
+        """Write into an unsealed object on behalf of a client-mode
+        driver that has no arena mmap (reference: ray client proxies
+        puts through the cluster; util/client/server/server.py)."""
+        entry = self.store.objects.get(oid)
+        if entry is None or entry.sealed:
+            return {"ok": False, "error": "object missing or sealed"}
+        if offset < 0 or offset + len(data) > entry.size:
+            # a bad offset must never scribble over neighboring objects
+            # in the shared arena
+            return {"ok": False,
+                    "error": f"write [{offset}, {offset + len(data)}) outside "
+                             f"object of size {entry.size}"}
+        if entry.location == "shm":
+            self.store.arena.view[
+                entry.offset + offset: entry.offset + offset + len(data)] = data
+        else:
+            with open(entry.path, "r+b") as f:
+                f.seek(offset)
+                f.write(data)
+        return {"ok": True}
+
     async def rpc_store_usage(self):
         return self.store.usage()
 
@@ -497,6 +527,7 @@ class NodeAgent(RpcHost):
         if w.lease_id is not None:
             lease = self._leases.pop(w.lease_id, None)
             if lease is not None:
+                self._free_tpu_chips.extend(lease.tpu_chips)
                 for tok in self._lease_sched(lease).release(lease.resources):
                     self._grant_token(tok)
         self.store.release_client(worker_id)
@@ -768,6 +799,11 @@ class NodeAgent(RpcHost):
         lease_id = f"{self.node_id[:12]}-{self._lease_counter}"
         lease = _Lease(lease_id, worker, demand, bundle_key,
                        seq=self._lease_counter)
+        n_tpu = int(demand.to_dict().get("TPU", 0))
+        take = min(n_tpu, len(self._free_tpu_chips))
+        if take > 0:
+            lease.tpu_chips = self._free_tpu_chips[:take]
+            del self._free_tpu_chips[:take]
         worker.lease_id = lease_id
         self._leases[lease_id] = lease
         return {"granted": {
@@ -775,6 +811,7 @@ class NodeAgent(RpcHost):
             "worker_id": worker.worker_id,
             "addr": [self.host, worker.port],
             "node_id": self.node_id,
+            "tpu_chips": lease.tpu_chips,
         }}
 
     async def _pop_worker(self, renv: Optional[Dict[str, Any]] = None
@@ -843,6 +880,7 @@ class NodeAgent(RpcHost):
         lease = self._leases.pop(lease_id, None)
         if lease is None:
             return {"ok": False}
+        self._free_tpu_chips.extend(lease.tpu_chips)
         w = lease.worker
         w.lease_id = None
         if kill_worker or w.proc.poll() is not None:

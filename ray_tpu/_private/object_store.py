@@ -603,3 +603,67 @@ class PlasmaClient:
 
     def close(self):
         self.arena.close(unlink=False)
+
+
+class RpcPlasmaClient(PlasmaClient):
+    """Store access for drivers with NO local arena (client mode): data
+    rides the control-plane RPC in chunks instead of shared memory.
+
+    Equivalent of the reference's Ray Client data path
+    (reference: python/ray/util/client/server/server.py — a remote
+    driver's puts/gets proxy through the cluster).  Slower than mmap by
+    design; correct from any machine that can reach the node agent.
+    """
+
+    _CHUNK = 4 * 1024 * 1024
+
+    def __init__(self, rpc, client_id: str):
+        self.arena = None  # no mmap: all data moves over RPC
+        self.rpc = rpc
+        self.client_id = client_id
+
+    def put_serialized(self, oid: str, frames, total_size: int,
+                       primary: bool = True) -> None:
+        from ray_tpu._private import serialization
+
+        buf = bytearray(total_size)
+        serialization.pack_into(frames, memoryview(buf))
+        self.put_raw(oid, buf, primary=primary)
+
+    def put_raw(self, oid: str, data, primary: bool = True) -> None:
+        # memoryview slices: no per-chunk copies (msgpack serializes any
+        # buffer-protocol object directly)
+        view = memoryview(data)
+        self.rpc.call("store_create", oid=oid, size=view.nbytes,
+                      primary=primary)
+        try:
+            for pos in range(0, view.nbytes, self._CHUNK):
+                reply = self.rpc.call(
+                    "store_write", oid=oid, offset=pos,
+                    data=view[pos:pos + self._CHUNK])
+                if not reply.get("ok"):
+                    raise RuntimeError(reply.get("error", "write failed"))
+        except BaseException:
+            self._abort(oid)
+            raise
+        self.rpc.call("store_seal", oid=oid)
+
+    def _load(self, oid: str, loc: Dict[str, Any]) -> Any:
+        from ray_tpu._private import serialization
+
+        size = loc["size"]
+        data = bytearray(size)
+        try:
+            for pos in range(0, size, self._CHUNK):
+                n = min(self._CHUNK, size - pos)
+                r = self.rpc.call("obj_chunk", oid=oid, offset=pos, length=n)
+                if not r.get("found"):
+                    raise KeyError(f"object {oid} vanished mid-read")
+                data[pos:pos + len(r["data"])] = r["data"]
+        finally:
+            # the bytes are ours now: drop the pin immediately
+            self._make_release(oid)()
+        return serialization.deserialize(memoryview(data))
+
+    def close(self):
+        pass

@@ -117,14 +117,18 @@ class _LineageEntry:
 
 class _Lease:
     __slots__ = ("lease_id", "worker_id", "addr", "agent_addr", "inflight",
-                 "linger_handle", "dead", "failed_head")
+                 "linger_handle", "dead", "failed_head", "tpu_chips")
 
     def __init__(self, lease_id: str, worker_id: str, addr: Tuple[str, int],
-                 agent_addr: Tuple[str, int]):
+                 agent_addr: Tuple[str, int],
+                 tpu_chips: Optional[List[int]] = None):
         self.lease_id = lease_id
         self.worker_id = worker_id
         self.addr = addr
         self.agent_addr = agent_addr
+        # concrete chip indices the lease's node agent assigned; exported
+        # to the executing worker as TPU_VISIBLE_CHIPS
+        self.tpu_chips = tpu_chips or []
         # tasks pushed but not yet replied, in push order (the worker
         # executes FIFO, so inflight[0] is the one actually running);
         # pipelining > 1 deep hides the push RPC round-trip (reference:
@@ -187,7 +191,15 @@ class CoreWorker(RpcHost):
         if not job_id:
             job_id = self.head.call("register_job")["job_id"]
         self.job_id = job_id
-        self.plasma = PlasmaClient(arena_path, self.agent, client_id=self.worker_id)
+        if arena_path:
+            self.plasma = PlasmaClient(arena_path, self.agent,
+                                       client_id=self.worker_id)
+        else:
+            # client mode: no local arena mmap — data rides the RPC
+            # (reference: ray client, util/client/)
+            from ray_tpu._private.object_store import RpcPlasmaClient
+
+            self.plasma = RpcPlasmaClient(self.agent, client_id=self.worker_id)
         self.memory = MemoryStore()
         self.rc = ReferenceCounter(self._free_object)
         self.functions = FunctionManager(self.head)
@@ -952,7 +964,8 @@ class CoreWorker(RpcHost):
                 if "granted" in reply:
                     g = reply["granted"]
                     lease = _Lease(g["lease_id"], g["worker_id"],
-                                   (g["addr"][0], g["addr"][1]), agent_addr)
+                                   (g["addr"][0], g["addr"][1]), agent_addr,
+                                   tpu_chips=g.get("tpu_chips"))
                     state.leases.append(lease)
                     return
                 if reply.get("error") == "infeasible":
@@ -1004,7 +1017,8 @@ class CoreWorker(RpcHost):
             if "granted" in reply:
                 g = reply["granted"]
                 lease = _Lease(g["lease_id"], g["worker_id"],
-                               (g["addr"][0], g["addr"][1]), addr)
+                               (g["addr"][0], g["addr"][1]), addr,
+                               tpu_chips=g.get("tpu_chips"))
                 state.leases.append(lease)
                 return
             if reply.get("error") == "bundle not reserved":
@@ -1031,6 +1045,7 @@ class CoreWorker(RpcHost):
         try:
             c = await self._aclient_worker(lease.addr)
             reply = await c.call("push_task", spec=task.spec.to_wire(),
+                                 tpu_chips=lease.tpu_chips,
                                  timeout=_TASK_PUSH_TIMEOUT)
         except (ConnectionLost, RpcError, Exception) as e:
             # only the task actually running (oldest in the worker's FIFO
@@ -1419,12 +1434,25 @@ class CoreWorker(RpcHost):
 
     # ------------------------------------------------------- task execution
 
-    async def rpc_push_task(self, spec: Dict[str, Any], instance: int = 0):
+    async def rpc_push_task(self, spec: Dict[str, Any], instance: int = 0,
+                            tpu_chips: Optional[List[int]] = None):
         """Execute a pushed task (worker mode). Runs user code on the exec
         thread; this handler awaits completion and carries the results back
         in the reply (reference: core_worker.proto PushTask)."""
         import asyncio
 
+        import os
+
+        if tpu_chips:
+            # the lease's node agent assigned these chips; jax reads
+            # TPU_VISIBLE_CHIPS at (lazy) plugin init so tasks sharing a
+            # node each see only their own chips (reference:
+            # accelerators/tpu.py set_current_process_visible_accelerator_ids)
+            os.environ["TPU_VISIBLE_CHIPS"] = ",".join(map(str, tpu_chips))
+        else:
+            # a reused worker must not leak the previous lease's chips to
+            # a task that reserved none
+            os.environ.pop("TPU_VISIBLE_CHIPS", None)
         fut = self._loop().create_future()
         self._task_queue.put((spec, fut))
         return await fut

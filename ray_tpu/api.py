@@ -61,6 +61,13 @@ def init(address: Optional[str] = None, *,
         address = _os.environ.get("RT_ADDRESS") or None
     if address == "local":
         address = None
+    client_mode = False
+    if address and address.startswith("rt://"):
+        # client mode (reference: ray.init("ray://...") — the driver may
+        # run on a machine with no access to the node's shm arena; object
+        # data proxies through the agent RPC instead of mmap)
+        client_mode = True
+        address = address[len("rt://"):]
 
     global _global_node
     with _state_lock:
@@ -103,7 +110,8 @@ def init(address: Optional[str] = None, *,
                     "arena_path": entry["arena_path"]}
             _global_node = None
         worker = CoreWorker(MODE_DRIVER, head_addr, info["addr"],
-                            info["arena_path"], info["node_id"])
+                            None if client_mode else info["arena_path"],
+                            info["node_id"])
         if runtime_env:
             # job-level default: every task/actor of this driver inherits
             # it unless overridden (reference: job_config.runtime_env)

@@ -1,0 +1,140 @@
+"""Pub/sub channels, client mode (rt://), and TPU chip visibility tests
+(reference: pubsub/publisher.h, util/client/, accelerators/tpu.py)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental import pubsub
+
+
+def test_pubsub_roundtrip(local_cluster):
+    seq1 = pubsub.publish("app-chan", {"x": 1})
+    seq2 = pubsub.publish("app-chan", {"x": 2})
+    assert seq2 == seq1 + 1
+    events = pubsub.poll("app-chan", after_seq=0)
+    assert [e["payload"]["x"] for e in events] == [1, 2]
+    assert pubsub.poll("app-chan", after_seq=seq2) == []
+    # long-poll wakes on publish
+    import threading
+
+    got = []
+    t = threading.Thread(target=lambda: got.extend(
+        pubsub.poll("app-chan", after_seq=seq2, timeout_s=10)))
+    t.start()
+    time.sleep(0.3)
+    pubsub.publish("app-chan", {"x": 3})
+    t.join(timeout=15)
+    assert [e["payload"]["x"] for e in got] == [3]
+
+
+def test_pubsub_builtin_channels(local_cluster):
+    # the single-node fixture registered one node at init
+    events = pubsub.poll("node_events", after_seq=0)
+    assert any(e["payload"]["event"] == "registered" for e in events)
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.remote()
+    ray_tpu.get(a.ping.remote(), timeout=60)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        ev = pubsub.poll("actor_events", after_seq=0)
+        if any(e["payload"]["state"] == "ALIVE" for e in ev):
+            break
+        time.sleep(0.2)
+    assert any(e["payload"]["state"] == "ALIVE" for e in ev)
+
+
+def test_client_mode_objects_tasks_actors():
+    """rt:// drivers have no arena mmap: puts/gets proxy over RPC."""
+    import numpy as np
+
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    ray_tpu.init(address=f"rt://{cluster.address}")
+    try:
+        from ray_tpu._private.object_store import RpcPlasmaClient
+        from ray_tpu._private.worker import global_worker_or_none
+
+        assert isinstance(global_worker_or_none().plasma, RpcPlasmaClient)
+        arr = np.arange(200_000, dtype=np.float32)  # > inline threshold
+        ref = ray_tpu.put(arr)
+        assert np.array_equal(ray_tpu.get(ref, timeout=60), arr)
+
+        @ray_tpu.remote
+        def double(x):
+            return x * 2
+
+        out = ray_tpu.get(double.remote(ref), timeout=120)
+        assert np.array_equal(out, arr * 2)
+
+        @ray_tpu.remote
+        class Acc:
+            def __init__(self):
+                self.v = 0
+
+            def add(self, x):
+                self.v += int(x)
+                return self.v
+
+        a = Acc.remote()
+        assert ray_tpu.get(a.add.remote(5), timeout=60) == 5
+        assert ray_tpu.get(a.add.remote(7), timeout=60) == 12
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_tpu_chip_visibility(tmp_path):
+    """Leases holding TPU resources pin specific chips; concurrent tasks
+    on one node see disjoint TPU_VISIBLE_CHIPS."""
+    ray_tpu.init(num_cpus=4, resources={"TPU": 4},
+                 object_store_memory=64 * 1024 * 1024)
+    try:
+        sync_dir = str(tmp_path)
+
+        def make(name):
+            @ray_tpu.remote(num_tpus=2, num_cpus=1, name=name)
+            def chips():
+                import os as _os
+                import time as _t
+
+                mine = _os.environ.get("TPU_VISIBLE_CHIPS", "")
+                open(f"{sync_dir}/{_os.getpid()}.chips", "w").write(mine)
+                # wait until BOTH tasks have reported (proves concurrency)
+                deadline = _t.time() + 30
+                while _t.time() < deadline:
+                    files = [f for f in _os.listdir(sync_dir)
+                             if f.endswith(".chips")]
+                    if len(files) >= 2:
+                        return mine
+                    _t.sleep(0.1)
+                return mine
+
+            return chips
+
+        r1, r2 = make("c1").remote(), make("c2").remote()
+        a, b = ray_tpu.get([r1, r2], timeout=120)
+        sa = set(a.split(",")) if a else set()
+        sb = set(b.split(",")) if b else set()
+        assert len(sa) == 2 and len(sb) == 2
+        assert not (sa & sb), f"chips overlap: {sa} & {sb}"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_metadata_env_first(monkeypatch):
+    from ray_tpu._private import accelerators
+
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5e-8")
+    assert accelerators.tpu_metadata("accelerator-type") == "v5e-8"
+    monkeypatch.delenv("TPU_ACCELERATOR_TYPE")
+    monkeypatch.setenv("RT_DISABLE_METADATA_SERVER", "1")
+    assert accelerators.tpu_metadata("accelerator-type") is None
