@@ -1449,9 +1449,11 @@ class CoreWorker(RpcHost):
             # node each see only their own chips (reference:
             # accelerators/tpu.py set_current_process_visible_accelerator_ids)
             os.environ["TPU_VISIBLE_CHIPS"] = ",".join(map(str, tpu_chips))
-        else:
-            # a reused worker must not leak the previous lease's chips to
-            # a task that reserved none
+        elif tpu_chips is not None:
+            # an explicit empty assignment (a CPU-task lease on a reused
+            # worker) must not leak the previous lease's chips.  None —
+            # actor METHOD pushes — leaves the constructor's assignment
+            # intact for the actor's lifetime.
             os.environ.pop("TPU_VISIBLE_CHIPS", None)
         fut = self._loop().create_future()
         self._task_queue.put((spec, fut))

@@ -10,6 +10,7 @@ of wrapping modules in DDP/FSDP.
 
 from ray_tpu.train.checkpoint import (CheckpointManager, restore_checkpoint,
                                       save_checkpoint)
+from ray_tpu.train.storage import StorageContext
 from ray_tpu.train.session import (TrainContext, get_context, report,
                                    get_checkpoint, get_dataset_shard)
 from ray_tpu.train.trainer import (JaxTrainer, Result, RunConfig,
@@ -19,4 +20,5 @@ from ray_tpu.train.worker_group import WorkerGroup
 __all__ = ["JaxTrainer", "ScalingConfig", "RunConfig", "Result",
            "TrainingFailedError", "WorkerGroup", "TrainContext",
            "get_context", "report", "get_checkpoint", "get_dataset_shard",
-           "save_checkpoint", "restore_checkpoint", "CheckpointManager"]
+           "save_checkpoint", "restore_checkpoint", "CheckpointManager",
+           "StorageContext"]

@@ -48,16 +48,23 @@ class CheckpointManager:
     (reference: train/_internal/checkpoint_manager.py)."""
 
     def __init__(self, directory: str, *, num_to_keep: int = 2,
-                 metric: Optional[str] = None, mode: str = "min"):
+                 metric: Optional[str] = None, mode: str = "min",
+                 storage: Optional["StorageContext"] = None):
         assert mode in ("min", "max")
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.num_to_keep = num_to_keep
         self.metric = metric
         self.mode = mode
+        # optional remote persistence (reference: StorageContext —
+        # checkpoints upload after local save, restore works from any
+        # host that can reach the storage path)
+        self.storage = storage
         self._entries: List[Dict[str, Any]] = []
         self._counter = 0
         self._load_index()
+        if not self._entries and storage is not None:
+            self._load_storage_index()
 
     def _index_path(self) -> str:
         return os.path.join(self.directory, "index.json")
@@ -75,14 +82,49 @@ class CheckpointManager:
         with open(self._index_path(), "w") as f:
             json.dump({"entries": self._entries, "counter": self._counter}, f)
 
+    def _load_storage_index(self):
+        text = self.storage.read_text("checkpoints/index.json")
+        if not text:
+            return
+        try:
+            data = json.loads(text)
+            self._entries = data["entries"]
+            self._counter = data["counter"]
+        except (ValueError, KeyError):
+            pass
+
     def save(self, state: Any, metrics: Optional[Dict[str, Any]] = None) -> str:
         self._counter += 1
-        path = os.path.join(self.directory, f"ckpt_{self._counter:06d}")
+        name = f"ckpt_{self._counter:06d}"
+        path = os.path.join(self.directory, name)
         save_checkpoint(path, state)
-        self._entries.append({"path": path, "metrics": metrics or {}})
+        entry: Dict[str, Any] = {"path": path, "metrics": metrics or {}}
+        if self.storage is not None:
+            # any storage path (NFS dir, memory://, s3://) gets the copy;
+            # a local path identical to `path` is a no-op
+            entry["uri"] = self.storage.persist_dir(
+                path, f"checkpoints/{name}")
+        self._entries.append(entry)
         self._evict()
         self._save_index()
+        if self.storage is not None:
+            self.storage.write_text(
+                "checkpoints/index.json",
+                json.dumps({"entries": self._entries,
+                            "counter": self._counter}))
         return path
+
+    def fetch(self, entry_path: str) -> str:
+        """Local path for a checkpoint, downloading from storage when
+        the local copy is absent (fresh host after a failover)."""
+        if os.path.exists(entry_path):
+            return entry_path
+        entry = next((e for e in self._entries
+                      if e["path"] == entry_path), None)
+        if entry is None or "uri" not in entry or self.storage is None:
+            return entry_path
+        local = os.path.join(self.directory, os.path.basename(entry_path))
+        return self.storage.fetch_dir(entry["uri"], local)
 
     def _score(self, entry) -> float:
         if self.metric is None:
@@ -95,15 +137,32 @@ class CheckpointManager:
     def _evict(self):
         if len(self._entries) <= self.num_to_keep:
             return
-        # keep the k best by metric (ties -> newest); always keep latest
+        # keep the k best by metric; metric-less -> most recent k
+        # (reference: checkpoint_manager.py default recency retention);
+        # the latest checkpoint is always kept for resume
         latest = self._entries[-1]
-        ranked = sorted(
-            self._entries[:-1],
-            key=self._score, reverse=(self.mode == "max"))
-        keep = ranked[:self.num_to_keep - 1] + [latest]
+        if self.metric is None:
+            keep = self._entries[-self.num_to_keep:]
+        else:
+            ranked = sorted(
+                self._entries[:-1],
+                key=self._score, reverse=(self.mode == "max"))
+            keep = ranked[:self.num_to_keep - 1] + [latest]
         for entry in self._entries:
             if entry not in keep:
                 shutil.rmtree(entry["path"], ignore_errors=True)
+                if "uri" in entry and self.storage is not None:
+                    try:  # evicted checkpoints leave storage too
+                        if self.storage.fs is None:
+                            if entry["uri"] != entry["path"]:
+                                shutil.rmtree(entry["uri"],
+                                              ignore_errors=True)
+                        else:
+                            self.storage.fs.rm(
+                                entry["uri"].split("://", 1)[1],
+                                recursive=True)
+                    except Exception:
+                        pass
         self._entries = [e for e in self._entries if e in keep]
 
     def best_checkpoint(self) -> Optional[str]:

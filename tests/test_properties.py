@@ -1,0 +1,116 @@
+"""Property-based invariants for the core data structures
+(SURVEY §5.2 race/sanitizer strategy: the reference leans on TSan +
+randomized stress; here hypothesis drives randomized operation
+sequences against single-process invariants — determinism of the
+scheduler policy, conservation in the resource accounting, and
+no-overlap/no-loss in the arena allocator).
+"""
+
+import random
+
+from hypothesis import given, settings, strategies as st
+
+from ray_tpu._private.object_store import FreeListAllocator
+from ray_tpu._private.resources import NodeResources, ResourceSet
+from ray_tpu._private.scheduler import LocalScheduler, pick_node
+
+
+# ------------------------------------------------------------- scheduler
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.integers(0, 2 ** 31 - 1),
+       st.lists(st.tuples(st.integers(1, 8), st.integers(0, 8)),
+                min_size=1, max_size=12),
+       st.integers(1, 4))
+def test_pick_node_deterministic_given_seed(seed, nodes, cpu_demand):
+    def build():
+        cluster = {}
+        for i, (total, used) in enumerate(nodes):
+            nr = NodeResources(ResourceSet({"CPU": float(total)}))
+            nr.acquire(ResourceSet({"CPU": float(min(used, total))}))
+            cluster[f"n{i}"] = nr
+        return cluster
+
+    demand = ResourceSet({"CPU": float(cpu_demand)})
+    a = pick_node(build(), demand, "n0", rng=random.Random(seed))
+    b = pick_node(build(), demand, "n0", rng=random.Random(seed))
+    assert a == b  # same seed + same state -> same decision
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.lists(st.tuples(st.integers(1, 8), st.integers(0, 8)),
+                min_size=1, max_size=12),
+       st.integers(1, 4),
+       st.integers(0, 2 ** 31 - 1))
+def test_pick_node_only_picks_feasible(nodes, cpu_demand, seed):
+    cluster = {}
+    for i, (total, used) in enumerate(nodes):
+        nr = NodeResources(ResourceSet({"CPU": float(total)}))
+        nr.acquire(ResourceSet({"CPU": float(min(used, total))}))
+        cluster[f"n{i}"] = nr
+    demand = ResourceSet({"CPU": float(cpu_demand)})
+    pick = pick_node(cluster, demand, "n0", rng=random.Random(seed))
+    if pick is None:
+        assert not any(nr.is_feasible(demand) for nr in cluster.values())
+    else:
+        assert cluster[pick].is_feasible(demand)
+
+
+# ------------------------------------------------- resource conservation
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.lists(st.integers(1, 5), min_size=1, max_size=30),
+       st.integers(2, 16))
+def test_local_scheduler_conserves_resources(demands, capacity):
+    """Any acquire/release interleaving ends with the full capacity
+    back and never drives availability negative."""
+    sched = LocalScheduler(NodeResources(ResourceSet(
+        {"CPU": float(capacity)})))
+    held = []
+    for d in demands:
+        demand = ResourceSet({"CPU": float(d)})
+        avail = sched.resources.available.to_dict().get("CPU", 0.0)
+        assert avail >= 0.0
+        if sched.try_acquire(demand):
+            assert d <= avail + 1e-9
+            held.append(demand)
+    for demand in held:
+        sched.release(demand)
+    assert sched.resources.available.to_dict()["CPU"] == float(capacity)
+
+
+# --------------------------------------------------------- arena allocator
+
+
+@settings(max_examples=40, deadline=None)
+@given(st.lists(st.one_of(
+    st.tuples(st.just("alloc"), st.integers(1, 4096)),
+    st.tuples(st.just("free"), st.integers(0, 100))),
+    min_size=1, max_size=120))
+def test_allocator_no_overlap_no_loss(ops):
+    """Random alloc/free sequences: live blocks never overlap, and after
+    freeing everything the allocator is back to zero bytes allocated."""
+    cap = 64 * 1024
+    alloc = FreeListAllocator(cap)
+    live = {}  # offset -> size
+    counter = 0
+    for op, arg in ops:
+        if op == "alloc":
+            off = alloc.alloc(arg)
+            if off is None:
+                continue
+            # no overlap with any live block
+            for o, s in live.items():
+                assert off + arg <= o or o + s <= off, \
+                    f"[{off},{off + arg}) overlaps [{o},{o + s})"
+            assert 0 <= off and off + arg <= cap
+            live[off] = arg
+            counter += 1
+        elif live:
+            off = sorted(live)[arg % len(live)]
+            alloc.free(off, live.pop(off))
+    for off, size in list(live.items()):
+        alloc.free(off, size)
+    assert alloc.allocated == 0

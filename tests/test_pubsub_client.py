@@ -138,3 +138,26 @@ def test_metadata_env_first(monkeypatch):
     monkeypatch.delenv("TPU_ACCELERATOR_TYPE")
     monkeypatch.setenv("RT_DISABLE_METADATA_SERVER", "1")
     assert accelerators.tpu_metadata("accelerator-type") is None
+
+
+def test_actor_keeps_chips_across_method_calls(tmp_path):
+    """Method pushes must not clear the constructor's chip assignment
+    (jax typically initializes lazily in the first METHOD, not
+    __init__)."""
+    ray_tpu.init(num_cpus=2, resources={"TPU": 4},
+                 object_store_memory=64 * 1024 * 1024)
+    try:
+        @ray_tpu.remote(num_tpus=2)
+        class T:
+            def chips(self):
+                import os as _os
+
+                return _os.environ.get("TPU_VISIBLE_CHIPS")
+
+        t = T.remote()
+        first = ray_tpu.get(t.chips.remote(), timeout=60)
+        second = ray_tpu.get(t.chips.remote(), timeout=60)
+        assert first is not None and len(first.split(",")) == 2
+        assert second == first
+    finally:
+        ray_tpu.shutdown()
