@@ -69,6 +69,19 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     lib.parallel_touch.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
                                    ctypes.c_int]
     lib.parallel_touch.restype = None
+    lib.fl_new.argtypes = [ctypes.c_size_t]
+    lib.fl_new.restype = ctypes.c_void_p
+    lib.fl_destroy.argtypes = [ctypes.c_void_p]
+    lib.fl_destroy.restype = None
+    lib.fl_alloc.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    lib.fl_alloc.restype = ctypes.c_size_t
+    lib.fl_free.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                            ctypes.c_size_t]
+    lib.fl_free.restype = ctypes.c_int
+    lib.fl_allocated.argtypes = [ctypes.c_void_p]
+    lib.fl_allocated.restype = ctypes.c_size_t
+    lib.fl_largest.argtypes = [ctypes.c_void_p]
+    lib.fl_largest.restype = ctypes.c_size_t
     return lib
 
 
@@ -141,6 +154,64 @@ def copy_into(dst, src) -> None:
         memoryview(dst)[:] = src
         return
     lib.parallel_copy(dst_addr, src_addr, dst_n, _COPY_THREADS)
+
+
+class NativeFreeListAllocator:
+    """C first-fit free-list allocator with coalescing; same contract as
+    object_store.FreeListAllocator (reference: plasma/malloc.cc is the
+    reference's native arena allocator).  Construct via make_allocator,
+    which returns None when the native library is unavailable."""
+
+    __slots__ = ("_h", "capacity")
+
+    def __init__(self, handle, capacity: int):
+        self._h = handle
+        self.capacity = capacity
+
+    @property
+    def allocated(self) -> int:
+        return _get_lib().fl_allocated(self._h)
+
+    def alloc(self, size: int):
+        off = _get_lib().fl_alloc(self._h, size)
+        return None if off == ctypes.c_size_t(-1).value else off
+
+    def free(self, offset: int, size: int) -> None:
+        if _get_lib().fl_free(self._h, offset, size) != 0:
+            # fl_free mutates nothing on failure; losing arena bytes
+            # silently is worse than surfacing the (tiny) realloc failure
+            raise MemoryError("free-list block array allocation failed")
+
+    def largest_free(self) -> int:
+        return _get_lib().fl_largest(self._h)
+
+    def __del__(self):
+        try:
+            lib = _lib  # skip rebuild during interpreter teardown
+            if lib is not None and self._h:
+                lib.fl_destroy(self._h)
+        except Exception:
+            pass
+
+
+def make_allocator(capacity: int, wait_s: float = 0.0):
+    """Native allocator instance, or None (caller falls back to the
+    behaviorally-identical Python FreeListAllocator).  By default this
+    NEVER waits for the background compile — a cold cache costs one run
+    on the Python allocator, not a startup stall.  Tests pass wait_s to
+    guarantee the native path."""
+    lib = _get_lib()
+    if lib is None and wait_s > 0:
+        t = _build_thread
+        if t is not None:
+            t.join(timeout=wait_s)
+        lib = _get_lib()
+    if lib is None:
+        return None
+    handle = lib.fl_new(capacity)
+    if not handle:
+        return None
+    return NativeFreeListAllocator(handle, capacity)
 
 
 def touch_pages(view) -> None:

@@ -109,3 +109,151 @@ void parallel_touch(const char *p, size_t n, int nthreads) {
     for (int i = 0; i < started; i++)
         pthread_join(threads[i], 0);
 }
+
+/* ---------------------------------------------------------------------
+ * First-fit free-list allocator with coalescing (the object-store
+ * arena allocator; reference: src/ray/object_manager/plasma/malloc.cc
+ * is likewise native).  Offsets and sizes are 64-byte aligned, matching
+ * the Python FreeListAllocator it replaces.
+ */
+
+#include <stdlib.h>
+
+typedef struct {
+    size_t off;
+    size_t size;
+} fl_block_t;
+
+typedef struct {
+    size_t capacity;
+    size_t allocated;
+    fl_block_t *blocks; /* sorted by offset */
+    size_t n;
+    size_t cap_blocks;
+} fl_t;
+
+static size_t fl_align(size_t n) {
+    n = n ? n : 1;
+    return (n + 63) & ~(size_t)63;
+}
+
+void *fl_new(size_t capacity) {
+    fl_t *f = (fl_t *)malloc(sizeof(fl_t));
+    if (!f)
+        return 0;
+    f->capacity = capacity;
+    f->allocated = 0;
+    f->cap_blocks = 16;
+    f->blocks = (fl_block_t *)malloc(f->cap_blocks * sizeof(fl_block_t));
+    if (!f->blocks) {
+        free(f);
+        return 0;
+    }
+    f->blocks[0].off = 0;
+    f->blocks[0].size = capacity;
+    f->n = 1;
+    return f;
+}
+
+void fl_destroy(void *h) {
+    fl_t *f = (fl_t *)h;
+    if (f) {
+        free(f->blocks);
+        free(f);
+    }
+}
+
+size_t fl_allocated(void *h) { return ((fl_t *)h)->allocated; }
+
+/* returns the offset, or (size_t)-1 when no block fits */
+size_t fl_alloc(void *h, size_t size) {
+    fl_t *f = (fl_t *)h;
+    size = fl_align(size);
+    for (size_t i = 0; i < f->n; i++) {
+        if (f->blocks[i].size >= size) {
+            size_t off = f->blocks[i].off;
+            if (f->blocks[i].size == size) {
+                for (size_t j = i + 1; j < f->n; j++)
+                    f->blocks[j - 1] = f->blocks[j];
+                f->n--;
+            } else {
+                f->blocks[i].off += size;
+                f->blocks[i].size -= size;
+            }
+            f->allocated += size;
+            return off;
+        }
+    }
+    return (size_t)-1;
+}
+
+static int fl_grow(fl_t *f) {
+    if (f->n < f->cap_blocks)
+        return 1;
+    size_t ncap = f->cap_blocks * 2;
+    fl_block_t *nb =
+        (fl_block_t *)realloc(f->blocks, ncap * sizeof(fl_block_t));
+    if (!nb)
+        return 0;
+    f->blocks = nb;
+    f->cap_blocks = ncap;
+    return 1;
+}
+
+/* returns 0 on success, -1 on internal allocation failure (in which
+ * case NO state was mutated — the caller may retry the free) */
+int fl_free(void *h, size_t offset, size_t size) {
+    fl_t *f = (fl_t *)h;
+    size = fl_align(size);
+    /* reserve block-array capacity BEFORE mutating anything: a failed
+     * realloc must not lose the region nor skew `allocated` */
+    if (!fl_grow(f))
+        return -1;
+    f->allocated -= size;
+    /* binary search for insertion point (blocks sorted by offset) */
+    size_t lo = 0, hi = f->n;
+    while (lo < hi) {
+        size_t mid = (lo + hi) / 2;
+        if (f->blocks[mid].off < offset)
+            lo = mid + 1;
+        else
+            hi = mid;
+    }
+    /* try to coalesce with the previous / next block without inserting */
+    int merged = 0;
+    if (lo > 0 &&
+        f->blocks[lo - 1].off + f->blocks[lo - 1].size == offset) {
+        f->blocks[lo - 1].size += size;
+        merged = 1;
+        /* may now touch the next block too */
+        if (lo < f->n &&
+            f->blocks[lo - 1].off + f->blocks[lo - 1].size ==
+                f->blocks[lo].off) {
+            f->blocks[lo - 1].size += f->blocks[lo].size;
+            for (size_t j = lo + 1; j < f->n; j++)
+                f->blocks[j - 1] = f->blocks[j];
+            f->n--;
+        }
+    } else if (lo < f->n && offset + size == f->blocks[lo].off) {
+        f->blocks[lo].off = offset;
+        f->blocks[lo].size += size;
+        merged = 1;
+    }
+    if (!merged) {
+        for (size_t j = f->n; j > lo; j--)
+            f->blocks[j] = f->blocks[j - 1];
+        f->blocks[lo].off = offset;
+        f->blocks[lo].size = size;
+        f->n++;
+    }
+    return 0;
+}
+
+size_t fl_largest(void *h) {
+    fl_t *f = (fl_t *)h;
+    size_t best = 0;
+    for (size_t i = 0; i < f->n; i++)
+        if (f->blocks[i].size > best)
+            best = f->blocks[i].size;
+    return best;
+}

@@ -116,6 +116,9 @@ class FreeListAllocator:
         self._free: List[Tuple[int, int]] = [(0, capacity)]
         self.allocated = 0
 
+    def largest_free(self) -> int:
+        return max((blk for _, blk in self._free), default=0)
+
     def alloc(self, size: int) -> Optional[int]:
         size = _aligned(max(size, 1))
         for i, (off, blk) in enumerate(self._free):
@@ -181,8 +184,13 @@ class StoreCore:
     """
 
     def __init__(self, arena_path: str, capacity: int, spill_dir: str):
+        from ray_tpu import _native
+
         self.arena = ShmArena.create(arena_path, capacity)
-        self.alloc = FreeListAllocator(capacity)
+        # native C allocator when the toolchain built it; Python fallback
+        # is behaviorally identical (reference: plasma/malloc.cc native)
+        self.alloc = _native.make_allocator(capacity) \
+            or FreeListAllocator(capacity)
         self.spill_dir = spill_dir
         os.makedirs(spill_dir, exist_ok=True)
         self.objects: Dict[str, _Entry] = {}
@@ -372,7 +380,7 @@ class StoreCore:
                 return
 
     def _headroom(self) -> int:
-        return max((blk for _, blk in self.alloc._free), default=0)
+        return self.alloc.largest_free()
 
     def _spill(self, oid: str, entry: _Entry) -> None:
         path = os.path.join(self.spill_dir, f"obj-{oid}")

@@ -92,13 +92,17 @@ class StorageContext:
 
         if os.path.exists(local_dir):
             shutil.rmtree(local_dir)
-        os.makedirs(os.path.dirname(local_dir) or ".", exist_ok=True)
-        self.fs.get(path.rstrip("/"), local_dir, recursive=True)
-        # fsspec memory/gcs implementations sometimes nest the dir name
-        inner = os.path.join(local_dir, posixpath.basename(path.rstrip("/")))
-        if not os.listdir(local_dir) == [] and os.path.isdir(inner) \
-                and len(os.listdir(local_dir)) == 1:
-            return inner
+        # per-file download keyed on the source listing: deterministic
+        # layout regardless of how a backend's recursive get nests dirs.
+        # find() returns backend-normalized paths — normalize the base
+        # the same way so relpath stays inside the tree.
+        src = self.fs._strip_protocol(path.rstrip("/"))
+        for remote_file in self.fs.find(src):
+            rel = posixpath.relpath(remote_file, src)
+            dest = os.path.join(local_dir, rel)
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            self.fs.get_file(remote_file, dest)
+        os.makedirs(local_dir, exist_ok=True)  # empty dirs still exist
         return local_dir
 
     # ------------------------------------------------------------ files

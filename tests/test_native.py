@@ -50,3 +50,33 @@ def test_touch_pages():
     buf = np.zeros(5 << 22, dtype=np.uint8)
     _native.touch_pages(memoryview(buf))  # must not crash or mutate
     assert not buf.any()
+
+
+def test_native_allocator_matches_python():
+    """The C allocator and the Python FreeListAllocator agree on a long
+    random alloc/free sequence (offsets, failures, allocated bytes)."""
+    import random
+
+    from ray_tpu._private.object_store import FreeListAllocator
+
+    native = _native.make_allocator(1 << 16, wait_s=60)
+    assert native is not None
+    py = FreeListAllocator(1 << 16)
+    rng = random.Random(42)
+    live = []
+    for _ in range(600):
+        if live and rng.random() < 0.45:
+            off, size = live.pop(rng.randrange(len(live)))
+            native.free(off, size)
+            py.free(off, size)
+        else:
+            size = rng.randint(1, 3000)
+            a, b = native.alloc(size), py.alloc(size)
+            assert a == b, f"divergence: native {a} vs python {b}"
+            if a is not None:
+                live.append((a, size))
+        assert native.allocated == py.allocated
+    for off, size in live:
+        native.free(off, size)
+        py.free(off, size)
+    assert native.allocated == py.allocated == 0

@@ -84,16 +84,31 @@ def test_local_scheduler_conserves_resources(demands, capacity):
 # --------------------------------------------------------- arena allocator
 
 
+import pytest
+
+
+def _make_alloc(kind, cap):
+    if kind == "python":
+        return FreeListAllocator(cap)
+    from ray_tpu import _native
+
+    alloc = _native.make_allocator(cap, wait_s=60)
+    assert alloc is not None, "native toolchain present: must build"
+    return alloc
+
+
+@pytest.mark.parametrize("kind", ["python", "native"])
 @settings(max_examples=40, deadline=None)
-@given(st.lists(st.one_of(
+@given(ops=st.lists(st.one_of(
     st.tuples(st.just("alloc"), st.integers(1, 4096)),
     st.tuples(st.just("free"), st.integers(0, 100))),
     min_size=1, max_size=120))
-def test_allocator_no_overlap_no_loss(ops):
+def test_allocator_no_overlap_no_loss(kind, ops):
     """Random alloc/free sequences: live blocks never overlap, and after
-    freeing everything the allocator is back to zero bytes allocated."""
+    freeing everything the allocator is back to zero bytes allocated.
+    Runs against BOTH the Python and the native C allocator."""
     cap = 64 * 1024
-    alloc = FreeListAllocator(cap)
+    alloc = _make_alloc(kind, cap)
     live = {}  # offset -> size
     counter = 0
     for op, arg in ops:

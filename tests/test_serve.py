@@ -275,3 +275,32 @@ def test_controller_crash_recovery(cluster):
     assert ray_tpu.get(ctrl2.list_deployments.remote(),
                        timeout=30).get("durable") == 2
     serve.delete("durable")
+
+
+def test_rpc_ingress(cluster):
+    """Binary (msgpack) ingress next to HTTP — the gRPC-ingress
+    equivalent (reference: _private/proxy.py gRPCProxy)."""
+    @serve.deployment(name="scorer")
+    class Scorer:
+        def __call__(self, xs):
+            return {"sum": sum(xs)}
+
+        def describe(self):
+            return "scorer-v1"
+
+    serve.run(Scorer.bind())
+    host, port = serve.start_rpc_ingress()
+    client = serve.RpcIngressClient(host, port)
+    try:
+        assert client.healthz()
+        assert "scorer" in client.routes()
+        assert client.invoke("scorer", [1, 2, 3]) == {"sum": 6}
+        assert client.invoke("scorer", method="describe") == "scorer-v1"
+        from ray_tpu._private.rpc import RpcError
+
+        with pytest.raises(RpcError):
+            client.invoke("nope", 1)
+    finally:
+        client.close()
+        serve.stop_rpc_ingress()
+        serve.delete("scorer")
