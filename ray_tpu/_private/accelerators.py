@@ -82,8 +82,24 @@ def _on_gce() -> bool:
         return False
 
 
+def num_gpus() -> int:
+    """NVIDIA GPU count via CUDA_VISIBLE_DEVICES / device files
+    (reference: accelerators/nvidia_gpu.py — TPU is the primary target
+    here, but mixed clusters schedule GPUs as ordinary resources)."""
+    env = os.environ.get("CUDA_VISIBLE_DEVICES")
+    if env is not None:
+        ids = [d for d in env.split(",") if d.strip() not in ("", "-1")]
+        return 0 if env.strip() in ("", "none", "NoDevFiles", "-1") \
+            else len(ids)
+    return len([p for p in glob.glob("/dev/nvidia[0-9]*")
+                if p[len("/dev/nvidia"):].isdigit()])
+
+
 def detect_accelerators() -> Dict[str, float]:
     out: Dict[str, float] = {}
+    gpus = num_gpus()
+    if gpus > 0:
+        out["GPU"] = float(gpus)
     chips = num_tpu_chips()
     if chips <= 0:
         return out

@@ -1063,9 +1063,66 @@ class HeadService(RpcHost):
         default_registry.add_collector(collect)
         try:
             self._metrics_server, self.metrics_port = \
-                await start_metrics_http_server(default_registry, host)
+                await start_metrics_http_server(
+                    default_registry, host,
+                    extra_routes={"/": self._render_dashboard,
+                                  "/api/state": self._render_state_json})
         except Exception:
             self.metrics_port = 0  # observability must never block boot
+
+    def _state_snapshot(self) -> Dict[str, Any]:
+        actors = {}
+        for a in self.actors.values():
+            actors[a.state] = actors.get(a.state, 0) + 1
+        return {
+            "nodes": [n.table_entry() for n in self.nodes.values()],
+            "actors_by_state": actors,
+            "num_placement_groups": len(self.placement_groups),
+            "num_task_events": len(self.task_events),
+            "kv_keys": len(self.kv),
+        }
+
+    def _render_state_json(self):
+        import json as _json
+
+        return "application/json", _json.dumps(self._state_snapshot(),
+                                               default=str).encode()
+
+    def _render_dashboard(self):
+        """One-page cluster overview on the head's metrics port
+        (reference: dashboard/ — a full web app; here a dependency-free
+        snapshot: nodes, resources, actors, links to /metrics)."""
+        import html as _html
+
+        s = self._state_snapshot()
+        rows = []
+        for n in s["nodes"]:
+            res = n["resources"]
+            avail, total = res.get("available", {}), res.get("total", {})
+            pretty = ", ".join(
+                f"{_html.escape(k)}: {avail.get(k, 0):g}/{v:g}"
+                for k, v in sorted(total.items()) if not k.startswith("node:"))
+            # labels/addrs are user-supplied strings: escape or a node
+            # registered with a <script> label XSSes the operator
+            rows.append(
+                f"<tr><td><code>{_html.escape(n['node_id'][:12])}</code></td>"
+                f"<td>{_html.escape(str(n['addr'][0]))}:{n['addr'][1]}</td>"
+                f"<td>{'head' if n.get('is_head_node') else 'worker'}</td>"
+                f"<td>{pretty}</td>"
+                f"<td>{_html.escape(str(n.get('labels') or ''))}</td></tr>")
+        actors = " ".join(f"{k}: {v}" for k, v in
+                          sorted(s["actors_by_state"].items())) or "none"
+        html = f"""<!doctype html><html><head><title>ray_tpu</title>
+<style>body{{font-family:sans-serif;margin:2em}}table{{border-collapse:collapse}}
+td,th{{border:1px solid #ccc;padding:4px 10px;text-align:left}}</style></head>
+<body><h1>ray_tpu cluster</h1>
+<p>{len(s['nodes'])} node(s) &middot; actors: {actors} &middot;
+{s['num_placement_groups']} placement group(s) &middot;
+<a href="/metrics">/metrics</a> &middot; <a href="/api/state">/api/state</a></p>
+<table><tr><th>node</th><th>address</th><th>role</th>
+<th>resources (avail/total)</th><th>labels</th></tr>
+{''.join(rows)}</table></body></html>"""
+        return "text/html", html.encode()
 
     async def rpc_task_events(self, events: List[Dict[str, Any]]):
         """Workers flush task state transitions here in batches

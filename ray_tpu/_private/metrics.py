@@ -256,11 +256,16 @@ default_registry = MetricsRegistry()
 
 async def start_metrics_http_server(registry: MetricsRegistry,
                                     host: str = "127.0.0.1",
-                                    port: int = 0) -> Tuple[asyncio.AbstractServer, int]:
-    """Minimal HTTP/1.0 exposition endpoint: `GET /metrics`.
+                                    port: int = 0,
+                                    extra_routes=None
+                                    ) -> Tuple[asyncio.AbstractServer, int]:
+    """Minimal HTTP/1.0 exposition endpoint: `GET /metrics`, plus any
+    ``extra_routes`` ({path: () -> (content_type, bytes)}) — the head
+    mounts its dashboard page here.
 
     Handcrafted on asyncio (no aiohttp in the image); Prometheus needs
     nothing beyond status line + content-type + body."""
+    extra_routes = extra_routes or {}
 
     async def handle(reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter):
@@ -271,15 +276,24 @@ async def start_metrics_http_server(registry: MetricsRegistry,
                 if line in (b"\r\n", b"\n", b""):
                     break
             parts = request.decode("latin-1").split()
-            path = parts[1] if len(parts) >= 2 else "/"
-            if path.split("?")[0] in ("/metrics", "/"):
+            path = (parts[1] if len(parts) >= 2 else "/").split("?")[0]
+            ctype = b"text/plain; version=0.0.4"
+            if path in extra_routes:
+                try:
+                    ct, body = extra_routes[path]()
+                    ctype = ct.encode()
+                    status = b"200 OK"
+                except Exception as e:  # route bug must not kill serving
+                    body = f"error: {e}\n".encode()
+                    status = b"500 Internal Server Error"
+            elif path in ("/metrics", "/"):
                 body = registry.render().encode()
                 status = b"200 OK"
             else:
                 body = b"not found\n"
                 status = b"404 Not Found"
             writer.write(b"HTTP/1.0 " + status +
-                         b"\r\nContent-Type: text/plain; version=0.0.4"
+                         b"\r\nContent-Type: " + ctype +
                          b"\r\nContent-Length: " + str(len(body)).encode() +
                          b"\r\n\r\n" + body)
             await writer.drain()

@@ -205,6 +205,7 @@ class Dataset:
         self._block_refs = block_refs   # source blocks (ObjectRefs)
         self._ops: List[_Op] = ops or []
         self._materialized: Optional[List[Any]] = None
+        self._last_stats: Dict[str, Any] = {}
 
     # ---- plan building ----
 
@@ -296,37 +297,84 @@ class Dataset:
     def num_blocks(self) -> int:
         return len(self._block_refs)
 
+    def explain(self) -> str:
+        """Human-readable logical plan: source blocks -> fused op chain
+        (reference: the planner's plan dump, _internal/planner/)."""
+        lines = [f"Source[{len(self._block_refs)} blocks]"]
+        fused: List[str] = []
+        for op in self._ops:
+            label = op.kind
+            if op.is_actor:
+                compute = op.compute or ActorPoolStrategy()
+                label += (f"(actor_pool[{compute.min_size}"
+                          f"..{compute.max_size}], "
+                          f"{getattr(op.fn, '__name__', 'cls')})")
+            else:
+                label += f"({getattr(op.fn, '__name__', 'fn')})"
+            fused.append(label)
+        if fused:
+            lines.append("  -> Fused[" + " | ".join(fused) + "]"
+                         + (" per-block task" if not self._has_actor_op()
+                            else " on actor pool"))
+        return "\n".join(lines)
+
+    def stats(self) -> Dict[str, Any]:
+        """Execution stats from the last iteration/materialization."""
+        return dict(self._last_stats)
+
     # ---- consumption ----
 
     def iter_blocks(self) -> Iterator[Any]:
         """Stream result blocks with a bounded in-flight window sized
         from live cluster resources and store occupancy
         (reference: streaming executor backpressure)."""
+        import time as _time
+
         import ray_tpu
 
-        if self._materialized is not None:
-            for ref in self._materialized:
-                yield ray_tpu.get(ref, timeout=600)
-            return
-        pending = list(self._block_refs)
-        in_flight: List[Any] = []
-        if self._has_actor_op():
-            actors = self._make_pool()
-            rr = 0
+        t0 = _time.perf_counter()
+        stats = {"blocks": 0, "rows": 0, "bytes": 0}
+
+        def tally(block):
+            stats["blocks"] += 1
+            acc = BlockAccessor(block)
+            stats["rows"] += acc.num_rows()
+            stats["bytes"] += getattr(block, "nbytes", 0)
+            return block
+
+        def finish():
+            stats["wall_s"] = round(_time.perf_counter() - t0, 4)
+            self._last_stats = stats
+
+        # try/finally: early-stopping consumers (take, schema) close the
+        # generator mid-stream — partial stats still finalize
+        try:
+            if self._materialized is not None:
+                for ref in self._materialized:
+                    yield tally(ray_tpu.get(ref, timeout=600))
+                return
+            pending = list(self._block_refs)
+            in_flight: List[Any] = []
+            if self._has_actor_op():
+                actors = self._make_pool()
+                rr = 0
+                while pending or in_flight:
+                    # ≤2 queued per actor keeps the pool busy without
+                    # flooding any single replica's mailbox
+                    while pending and len(in_flight) < 2 * len(actors):
+                        in_flight.append(
+                            actors[rr % len(actors)].apply.remote(
+                                pending.pop(0)))
+                        rr += 1
+                    yield tally(ray_tpu.get(in_flight.pop(0), timeout=600))
+                return
             while pending or in_flight:
-                # ≤2 queued per actor keeps the pool busy without
-                # flooding any single replica's mailbox
-                while pending and len(in_flight) < 2 * len(actors):
-                    in_flight.append(
-                        actors[rr % len(actors)].apply.remote(pending.pop(0)))
-                    rr += 1
-                yield ray_tpu.get(in_flight.pop(0), timeout=600)
-            return
-        while pending or in_flight:
-            while pending and len(in_flight) < _stream_window():
-                in_flight.append(self._submit_block(pending.pop(0)))
-            ref = in_flight.pop(0)
-            yield ray_tpu.get(ref, timeout=600)
+                while pending and len(in_flight) < _stream_window():
+                    in_flight.append(self._submit_block(pending.pop(0)))
+                ref = in_flight.pop(0)
+                yield tally(ray_tpu.get(ref, timeout=600))
+        finally:
+            finish()
 
     def iter_rows(self) -> Iterator[dict]:
         for block in self.iter_blocks():

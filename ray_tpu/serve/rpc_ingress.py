@@ -120,7 +120,10 @@ class _RpcIngress:
             else handle.method(method)
         try:
             return ray_tpu.get(caller(*args, **kwargs), timeout=timeout)
-        except ray_tpu.RayError:
+        except (ray_tpu.ActorDiedError, ray_tpu.ActorUnavailableError,
+                ray_tpu.RayWorkerError):
+            # replica infrastructure failure only — an application error
+            # or timeout must NOT re-execute a side-effecting request;
             # replicas may have been replaced wholesale: refresh once
             self._handles.pop(name, None)
             handle = serve_api.get_handle(name)

@@ -97,12 +97,16 @@ class StorageContext:
         # find() returns backend-normalized paths — normalize the base
         # the same way so relpath stays inside the tree.
         src = self.fs._strip_protocol(path.rstrip("/"))
-        for remote_file in self.fs.find(src):
-            rel = posixpath.relpath(remote_file, src)
+        for remote_path, info in self.fs.find(src, withdirs=True,
+                                              detail=True).items():
+            rel = posixpath.relpath(remote_path, src)
             dest = os.path.join(local_dir, rel)
+            if info.get("type") == "directory":
+                os.makedirs(dest, exist_ok=True)  # keep empty dirs
+                continue
             os.makedirs(os.path.dirname(dest), exist_ok=True)
-            self.fs.get_file(remote_file, dest)
-        os.makedirs(local_dir, exist_ok=True)  # empty dirs still exist
+            self.fs.get_file(remote_path, dest)
+        os.makedirs(local_dir, exist_ok=True)
         return local_dir
 
     # ------------------------------------------------------------ files

@@ -153,3 +153,16 @@ def test_stream_window_is_resource_aware(cluster):
     assert ds_mod._WINDOW_MIN <= w <= ds_mod._WINDOW_MAX
     # 4-CPU test cluster: 2 tasks per CPU
     assert w == 8
+
+
+def test_explain_and_stats(cluster):
+    from ray_tpu import data
+
+    ds = data.range(20, num_blocks=4).map(lambda r: r).filter(
+        lambda r: r["id"] % 2 == 0)
+    plan = ds.explain()
+    assert "Source[4 blocks]" in plan and "map" in plan and "filter" in plan
+    assert ds.count() == 10
+    stats = ds.stats()
+    assert stats["blocks"] == 4 and stats["rows"] == 10
+    assert stats["wall_s"] > 0

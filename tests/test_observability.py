@@ -171,3 +171,23 @@ def test_list_objects(cluster):
     assert any(o["object_id"] == ref.oid for o in objs), objs
     assert all("size" in o and "node_id" in o for o in objs)
     del ref
+
+
+def test_head_dashboard_page(local_cluster):
+    """The head's metrics port serves a one-page dashboard + state JSON
+    (reference: dashboard/)."""
+    import json
+    import urllib.request
+
+    import ray_tpu as rt
+
+    port = rt.api._worker().head.call("metrics_port")["port"]
+    assert port
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/", timeout=10) as r:
+        html = r.read().decode()
+    assert "ray_tpu cluster" in html and "resources" in html
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/api/state",
+                                timeout=10) as r:
+        state = json.loads(r.read())
+    assert len(state["nodes"]) == 1
+    assert "actors_by_state" in state
