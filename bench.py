@@ -69,6 +69,31 @@ def bench_actor(ray_tpu, n_sync=300, n_async=2000):
     ray_tpu.get([a.m.remote() for _ in range(n_async)], timeout=120)
     return sync, n_async / (time.perf_counter() - t0)
 
+def bench_small_ops(ray_tpu, n=1000):
+    """Small-object put/get ops/s (reference: ray_perf.py:120-122,
+    'single client get/put' — 10,181.6 / 5,545.0 ops/s recorded)."""
+    payload = b"x" * 100
+    t0 = time.perf_counter()
+    refs = [ray_tpu.put(payload) for _ in range(n)]
+    put_rate = n / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    for r in refs:
+        ray_tpu.get(r, timeout=60)
+    get_rate = n / (time.perf_counter() - t0)
+    return put_rate, get_rate
+
+def bench_pg_churn(ray_tpu, n=40):
+    """Placement group create+remove rate (reference:
+    microbenchmark.json 'placement group create/removal' 796.6/s)."""
+    from ray_tpu.util import placement_group, remove_placement_group
+
+    t0 = time.perf_counter()
+    for _ in range(n):
+        pg = placement_group([{"CPU": 1}])
+        pg.wait(timeout=30)
+        remove_placement_group(pg)
+    return n / (time.perf_counter() - t0)
+
 def bench_put_gbps(ray_tpu, mb=100, iters=5):
     import numpy as np
 
@@ -187,6 +212,15 @@ def main():
             extras["actor_async_per_s"] = round(a_async, 1)
 
         phase("actors", actors)
+
+        def small_ops():
+            p, g = bench_small_ops(ray_tpu)
+            extras["put_small_per_s"] = round(p, 1)
+            extras["get_small_per_s"] = round(g, 1)
+
+        phase("small_ops", small_ops)
+        phase("pg_churn", lambda: extras.__setitem__(
+            "pg_create_remove_per_s", round(bench_pg_churn(ray_tpu), 1)))
         phase("put", lambda: extras.__setitem__(
             "put_gb_per_s", round(bench_put_gbps(ray_tpu), 2)))
         try:

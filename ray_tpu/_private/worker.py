@@ -363,6 +363,10 @@ class CoreWorker(RpcHost):
             return
         borrowed_done = self.rc.remove_local(ref.oid)
         if borrowed_done and ref.owner_addr is not None:
+            # drop the cached inline value too: borrowed entries are only
+            # evicted here (owner-side eviction runs in _free_object), so
+            # keeping them would leak every borrowed small object
+            self.memory.evict(ref.oid)
             self._spawn(self._send_remove_borrow(tuple(ref.owner_addr), ref.oid))
 
     async def _send_remove_borrow(self, owner: Tuple[str, int], oid: str):
@@ -498,12 +502,24 @@ class CoreWorker(RpcHost):
         oid = self._next_put_oid()
         with SerializationContext() as ctx:
             frames, size = serialization.serialize(value)
-        self.plasma.put_serialized(oid, frames, size, primary=True)
-        self._locations[oid] = self.agent_addr
+        if size <= config.max_direct_call_object_size:
+            # small values stay in the owner's in-process store, skipping
+            # two plasma RPC round-trips (reference: memory_store.cc —
+            # ray.put below the direct-call threshold avoids plasma).
+            # Borrowers resolve inline via fetch_object; task args inline
+            # through _resolve_deps; the existing machinery covers both.
+            buf = bytearray(size)
+            serialization.pack_into(frames, memoryview(buf))
+            self.memory.set_raw(oid, bytes(buf))
+            node_addr = None
+        else:
+            self.plasma.put_serialized(oid, frames, size, primary=True)
+            self._locations[oid] = self.agent_addr
+            node_addr = self.agent_addr
         if ctx.refs:
             # the stored value embeds refs: pin them for the outer's lifetime
             self._containers[oid] = list(ctx.refs)
-        return ObjectRef(oid, owner_addr=self.address, node_addr=self.agent_addr)
+        return ObjectRef(oid, owner_addr=self.address, node_addr=node_addr)
 
     # ------------------------------------------------------------------- get
 
@@ -534,9 +550,13 @@ class CoreWorker(RpcHost):
                             carry.append((i, ref))
                             continue
                         if value is None:
-                            with SerializationContext():
+                            with SerializationContext() as dctx:
                                 value = serialization.deserialize(raw)
                                 entry.value = value
+                            # nested refs inside an inline value are live
+                            # borrows — register them with their owners,
+                            # exactly as the plasma fetch path does
+                            self._register_foreign_refs(dctx.refs)
                         out[i] = value
                 elif self.rc.is_freed(oid):
                     raise ObjectFreedError(f"object {oid[:16]} was freed by its owner")
@@ -546,7 +566,11 @@ class CoreWorker(RpcHost):
                             and tuple(ref.owner_addr) != self.address:
                         node = self._resolve_via_owner(ref, deadline)
                         if node is None:
-                            continue  # value already placed in out by resolver
+                            # the resolver stored the inline value in the
+                            # MEMORY STORE; revisit next round to read it
+                            # into out (the memory.known branch)
+                            carry.append((i, ref))
+                            continue
                     if node is None:
                         node = self._locations.get(oid, self.agent_addr)
                     plasma_fetch.append((i, ref, node))

@@ -140,3 +140,32 @@ def test_contained_ref_pinned_by_outer(cluster):
     assert _store_contains(oid), "inner freed while outer object exists"
     back = ray_tpu.get(outer, timeout=30)
     assert float(ray_tpu.get(back["inner"], timeout=30).sum()) == 300_000.0
+
+
+def test_borrowed_inline_nested_ref_stays_alive(cluster):
+    """A nested ref deserialized out of an inline (small-put) container
+    registers a borrow — the owner must not free it while the borrower
+    holds the inner ref (reference: reference_count.h nested borrows)."""
+    import gc
+    import time
+
+    import ray_tpu as rt
+
+    inner = rt.put({"payload": 123})
+    outer = rt.put([inner])  # small: memory-store path
+
+    @rt.remote
+    class Holder:
+        def take(self, refs):
+            self.inner = rt.get(refs[0], timeout=30)[0]  # keep inner ref
+            return True
+
+        def read(self):
+            return rt.get(self.inner, timeout=30)["payload"]
+
+    h = Holder.remote()
+    assert rt.get(h.take.remote([outer]), timeout=60)
+    del inner, outer  # driver drops BOTH; borrower still holds inner
+    gc.collect()
+    time.sleep(1.0)  # let remove_borrow/free propagation settle
+    assert rt.get(h.read.remote(), timeout=60) == 123

@@ -288,3 +288,34 @@ def test_infeasible_task_errors(cluster):
 
     with pytest.raises(ray_tpu.SchedulingError):
         ray_tpu.get(heavy.remote(), timeout=60)
+
+
+def test_small_put_stays_in_memory_store(cluster):
+    """Small puts skip plasma (reference: memory_store.cc direct-call
+    threshold); borrowers receiving the ref inside a container resolve
+    the value inline from the owner."""
+    import ray_tpu as rt
+
+    r = rt.put({"k": list(range(40))})
+    w = rt.api._worker()
+    assert w.memory.known(r.oid)          # owner-side in-process value
+    assert r.oid not in w._locations      # never touched plasma
+    assert rt.get(r, timeout=30)["k"][5] == 5
+
+    @rt.remote
+    def direct(d):                        # inlined as a task arg
+        return sum(d["k"])
+
+    assert rt.get(direct.remote(r), timeout=60) == sum(range(40))
+
+    @rt.remote
+    class Borrower:                       # ref inside a container
+        def read(self, refs):
+            return rt.get(refs[0], timeout=30)["k"][-1]
+
+    b = Borrower.remote()
+    assert rt.get(b.read.remote([r]), timeout=60) == 39
+
+    big = rt.put(b"x" * (1024 * 1024))    # large: plasma as before
+    assert big.oid in w._locations
+    assert rt.get(big, timeout=30) == b"x" * (1024 * 1024)
