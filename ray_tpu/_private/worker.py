@@ -28,6 +28,7 @@ pins).
 from __future__ import annotations
 
 import asyncio
+import inspect
 import os
 import queue
 import threading
@@ -1566,6 +1567,12 @@ class CoreWorker(RpcHost):
             else:
                 fn = self.functions.fetch(spec.function_id)
                 value = fn(*args, **kwargs)
+            if inspect.iscoroutine(value):
+                # async def tasks/actor methods (reference: async actors,
+                # _raylet.pyx execute_task coroutine path).  Each
+                # exec thread drives its own loop, so max_concurrency
+                # async methods await I/O concurrently across threads.
+                value = self._run_coroutine(value)
         except BaseException as e:
             m["failed"].inc()
             m["duration"].observe(time.time() - t0)
@@ -1575,6 +1582,27 @@ class CoreWorker(RpcHost):
         m["duration"].observe(time.time() - t0)
         self.record_task_event(spec.task_id, "FINISHED")
         return self._success_reply(spec, value, arg_ref_oids)
+
+    _async_exec_loop = None
+    _async_exec_lock = threading.Lock()
+
+    def _run_coroutine(self, coro):
+        """Drive an async task/method on ONE persistent event loop
+        shared by every exec thread (reference: async actors run all
+        coroutines on a single loop).  That makes loop-bound resources
+        (client sessions, asyncio.Lock/Queue) created in one call usable
+        in later calls regardless of which exec thread serves them, and
+        keeps background asyncio.create_task work running between calls
+        — the loop never stops.  Exec threads block on the result, so
+        max_concurrency calls still overlap their awaits."""
+        with self._async_exec_lock:
+            loop = type(self)._async_exec_loop
+            if loop is None or loop.is_closed():
+                loop = asyncio.new_event_loop()
+                type(self)._async_exec_loop = loop
+                threading.Thread(target=loop.run_forever,
+                                 name="rt-async-exec", daemon=True).start()
+        return asyncio.run_coroutine_threadsafe(coro, loop).result()
 
     def _materialize_args(self, spec: TaskSpec):
         """Deserialize inline args and batch-fetch ref args, preserving

@@ -319,3 +319,42 @@ def test_small_put_stays_in_memory_store(cluster):
     big = rt.put(b"x" * (1024 * 1024))    # large: plasma as before
     assert big.oid in w._locations
     assert rt.get(big, timeout=30) == b"x" * (1024 * 1024)
+
+
+def test_async_tasks_and_actor_methods(cluster):
+    """async def tasks and actor methods run to completion; an actor
+    with max_concurrency overlaps async waits across calls, and
+    loop-bound state created in one call works in later calls
+    (reference: async actors — one shared event loop)."""
+    @ray_tpu.remote
+    async def atask(x):
+        import asyncio as _a
+
+        await _a.sleep(0.05)
+        return x * 3
+
+    assert ray_tpu.get(atask.remote(14), timeout=60) == 42
+
+    @ray_tpu.remote(max_concurrency=4)
+    class AsyncActor:
+        async def setup(self):
+            import asyncio as _a
+
+            self.lock = _a.Lock()  # loop-bound resource
+            return True
+
+        async def slow_echo(self, v):
+            import asyncio as _a
+
+            async with self.lock:  # must be usable from ANY later call
+                pass
+            await _a.sleep(0.4)
+            return v
+
+    a = AsyncActor.remote()
+    assert ray_tpu.get(a.setup.remote(), timeout=60)
+    t0 = time.time()
+    out = ray_tpu.get([a.slow_echo.remote(i) for i in range(4)], timeout=60)
+    wall = time.time() - t0
+    assert sorted(out) == [0, 1, 2, 3]
+    assert wall < 1.3, f"async calls did not overlap: {wall:.2f}s"
