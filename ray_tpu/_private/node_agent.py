@@ -72,7 +72,7 @@ def _is_hard_strategy(strategy: Dict[str, Any]) -> bool:
 
 class _Lease:
     __slots__ = ("lease_id", "worker", "resources", "bundle_key", "seq",
-                 "tpu_chips")
+                 "tpu_chips", "blocked", "donated")
 
     def __init__(self, lease_id: str, worker: _Worker, resources: ResourceSet,
                  bundle_key: str = "", seq: int = 0):
@@ -82,6 +82,12 @@ class _Lease:
         self.bundle_key = bundle_key
         self.seq = seq  # grant order; the OOM policy kills newest first
         self.tpu_chips: List[int] = []  # chip indices assigned to this lease
+        # True while the leased worker is blocked in a get(): its
+        # fungible resources are returned to the pool so nested tasks
+        # can run (reference: node_manager HandleWorkerBlocked/Unblocked
+        # — CPU only; accelerators stay bound to their chip assignment)
+        self.blocked = False
+        self.donated: Optional[ResourceSet] = None  # what blocking released
 
 
 class NodeAgent(RpcHost):
@@ -528,8 +534,7 @@ class NodeAgent(RpcHost):
             lease = self._leases.pop(w.lease_id, None)
             if lease is not None:
                 self._free_tpu_chips.extend(lease.tpu_chips)
-                for tok in self._lease_sched(lease).release(lease.resources):
-                    self._grant_token(tok)
+                self._release_lease_resources(lease)
         self.store.release_client(worker_id)
         if self._head is not None:
             asyncio.ensure_future(self._report_worker_death(worker_id, reason))
@@ -732,7 +737,7 @@ class NodeAgent(RpcHost):
                                  demand: ResourceSet, bundle_key: str,
                                  ts: Optional[TaskSpec] = None):
         if sched.try_acquire(demand):
-            return await self._grant(sched, demand, bundle_key, ts)
+            return await self._grant_safe(sched, demand, bundle_key, ts)
         # queue FIFO-with-resources
         token = object()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -751,7 +756,7 @@ class NodeAgent(RpcHost):
                     return {"error": "bundle not reserved",
                             "error_str": "placement group removed while queued"}
                 # granted between timeout and cancel; resources are ours
-                return await self._grant(sched, demand, bundle_key, ts)
+                return await self._grant_safe(sched, demand, bundle_key, ts)
             # if not found and fut is cancelled, _grant_token already gave
             # the acquired resources back — nothing more to do here
             return {"error": "lease timeout",
@@ -759,7 +764,7 @@ class NodeAgent(RpcHost):
         if bundle_key and bundle_key not in self._bundles:
             return {"error": "bundle not reserved",
                     "error_str": "placement group removed while queued"}
-        return await self._grant(sched, demand, bundle_key, ts)
+        return await self._grant_safe(sched, demand, bundle_key, ts)
 
     def _grant_token(self, token: object):
         entry = self._lease_waiters.pop(token, None)
@@ -777,6 +782,19 @@ class NodeAgent(RpcHost):
         for sched in [self.local, *self._bundles.values()]:
             for tok in sched.drain():
                 self._grant_token(tok)
+
+    async def _grant_safe(self, sched: LocalScheduler, demand: ResourceSet,
+                          bundle_key: str = "",
+                          ts: Optional[TaskSpec] = None):
+        """_grant, releasing the already-acquired resources if it raises
+        unexpectedly — a grant-path bug must not leak node capacity."""
+        try:
+            return await self._grant(sched, demand, bundle_key, ts)
+        except Exception as exc:
+            for tok in sched.release(demand):
+                self._grant_token(tok)
+            return {"error": "grant failed",
+                    "error_str": f"{type(exc).__name__}: {exc}"}
 
     async def _grant(self, sched: LocalScheduler, demand: ResourceSet,
                      bundle_key: str = "", ts: Optional[TaskSpec] = None):
@@ -891,8 +909,63 @@ class NodeAgent(RpcHost):
         else:
             w.idle_since = time.monotonic()
             self._idle.append(w)
-        for tok in self._lease_sched(lease).release(lease.resources):
+        self._release_lease_resources(lease)
+        return {"ok": True}
+
+    def _release_lease_resources(self, lease: _Lease) -> None:
+        """Return a finished lease's still-held resources to the pool —
+        the full set normally, or only the undonated (accelerator)
+        remainder when the lease died/returned while blocked."""
+        if lease.blocked and lease.donated is not None:
+            donated_keys = set(lease.donated.to_dict())
+            held = ResourceSet({k: v for k, v in
+                                lease.resources.to_dict().items()
+                                if k not in donated_keys})
+        else:
+            held = lease.resources
+        for tok in self._lease_sched(lease).release(held):
             self._grant_token(tok)
+
+    # ---- blocked-worker resource release -----------------------------------
+    # A worker blocked in get() inside a task hands its lease's resources
+    # back so nested tasks can schedule — without this, N-deep task
+    # nesting deadlocks once depth exceeds the node's CPU count
+    # (reference: node_manager.cc HandleWorkerBlocked: "the worker is
+    # blocked waiting for objects; release its CPU resources").
+
+    def _lease_of_worker(self, worker_id: str) -> Optional[_Lease]:
+        w = self._workers.get(worker_id)
+        if w is None or w.lease_id is None:
+            return None
+        return self._leases.get(w.lease_id)
+
+    async def rpc_worker_blocked(self, worker_id: str):
+        lease = self._lease_of_worker(worker_id)
+        if lease is not None and not lease.blocked:
+            # fungible resources only: TPU/GPU counts map to concrete
+            # chip assignments the lease keeps — donating them would let
+            # a nested task be granted an accelerator count with zero
+            # actual chips behind it
+            donated = ResourceSet({
+                k: v for k, v in lease.resources.to_dict().items()
+                if k not in ("TPU", "GPU")})
+            lease.blocked = True
+            lease.donated = donated
+            for tok in self._lease_sched(lease).release(donated):
+                self._grant_token(tok)
+        return {"ok": True}
+
+    async def rpc_worker_unblocked(self, worker_id: str):
+        lease = self._lease_of_worker(worker_id)
+        if lease is not None and lease.blocked:
+            # direct re-acquire, bypassing the FIFO queue: the task is
+            # already running and must not stall behind queued leases.
+            # If the pool can't cover it right now the lease stays
+            # 'blocked' (resources remain donated) — brief oversubscription,
+            # exactly the reference's re-acquire semantics.
+            if self._lease_sched(lease).resources.acquire(lease.donated):
+                lease.blocked = False
+                lease.donated = None
         return {"ok": True}
 
     # ---- misc --------------------------------------------------------------

@@ -88,6 +88,27 @@ class _ExecState(threading.local):
     num_returns: int = 0
 
 
+class _ExecShadow:
+    """Per-coroutine snapshot of _ExecState: async task bodies run on
+    the shared loop thread where the exec thread's threading.local is
+    invisible; a contextvar carries this shadow instead (isolated per
+    asyncio.Task, so interleaved coroutines can't see each other's)."""
+
+    __slots__ = ("task_id", "job_id", "put_index", "num_returns")
+
+    def __init__(self, src: "_ExecState"):
+        self.task_id = src.task_id
+        self.job_id = src.job_id
+        self.put_index = src.put_index
+        self.num_returns = src.num_returns
+
+
+_exec_ctx: "contextvars.ContextVar" = None  # initialized below
+import contextvars  # noqa: E402 — adjacent to its single use
+
+_exec_ctx = contextvars.ContextVar("rt_exec_shadow", default=None)
+
+
 class _TaskState:
     __slots__ = ("spec", "contained_refs", "retries_left", "sched_key",
                  "return_oids", "deps_ready")
@@ -220,11 +241,13 @@ class CoreWorker(RpcHost):
         self._actors: Dict[str, _ActorState] = {}
         self._agent_clients: Dict[Tuple[str, int], RpcClient] = {}
         self._worker_clients: Dict[Tuple[str, int], RpcClient] = {}
-        self._exec = _ExecState()
+        self._exec_tls = _ExecState()
         self._exec.job_id = job_id
         self._exec.task_id = TaskID.for_driver(JobID.from_hex(job_id)).hex()
         self._put_counter = 0
         self._put_lock = threading.Lock()
+        self._block_depth = 0  # nested blocking gets (see _notify_blocked)
+        self._block_lock = threading.Lock()
         self._shutdown = False
         # observability: task-event buffer flushed to the head in batches
         # (reference: task_event_buffer.h:206) + process metrics pushed
@@ -238,6 +261,14 @@ class CoreWorker(RpcHost):
         self._actor_creation_spec: Optional[TaskSpec] = None
         self._pending_acks: Dict[str, Any] = {}  # task_id -> held values
         self._exec_threads: List[threading.Thread] = []
+
+    @property
+    def _exec(self):
+        """Execution context: the per-coroutine shadow when running an
+        async task body on the shared loop thread, else the exec
+        thread's threading.local."""
+        shadow = _exec_ctx.get()
+        return shadow if shadow is not None else self._exec_tls
 
     # ------------------------------------------------------- observability
 
@@ -525,7 +556,40 @@ class CoreWorker(RpcHost):
     # ------------------------------------------------------------------- get
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        # a worker blocking inside a task donates its lease's resources
+        # so nested tasks can schedule (reference: HandleWorkerBlocked) —
+        # without this, task nesting deeper than the node's CPU count
+        # deadlocks.  Fast path (everything already resolved) skips the
+        # agent round-trip entirely.
+        # the deadline starts NOW — the blocked-notification RPC below
+        # must not eat into the caller's budget
         deadline = None if timeout is None else time.monotonic() + timeout
+        notify = (self.mode == MODE_WORKER and self._exec.task_id
+                  and not all(self.memory.ready(r.oid) for r in refs))
+        if notify:
+            self._notify_blocked(True)
+        try:
+            return self._get_inner(refs, deadline)
+        finally:
+            if notify:
+                self._notify_blocked(False)
+
+    def _notify_blocked(self, blocked: bool) -> None:
+        with self._block_lock:
+            self._block_depth += 1 if blocked else -1
+            edge = (self._block_depth == 1) if blocked \
+                else (self._block_depth == 0)
+        if not edge:
+            return
+        try:
+            self.agent.call(
+                "worker_blocked" if blocked else "worker_unblocked",
+                worker_id=self.worker_id, timeout=2.0)
+        except Exception:
+            pass  # agent briefly unreachable: accounting-only feature
+
+    def _get_inner(self, refs: Sequence[ObjectRef],
+                   deadline: Optional[float] = None) -> List[Any]:
         out: List[Any] = [None] * len(refs)
         pending: List[Tuple[int, ObjectRef]] = list(enumerate(refs))
         for _round in range(_MAX_RECONSTRUCTION_ROUNDS):
@@ -1569,9 +1633,11 @@ class CoreWorker(RpcHost):
                 value = fn(*args, **kwargs)
             if inspect.iscoroutine(value):
                 # async def tasks/actor methods (reference: async actors,
-                # _raylet.pyx execute_task coroutine path).  Each
-                # exec thread drives its own loop, so max_concurrency
-                # async methods await I/O concurrently across threads.
+                # _raylet.pyx execute_task coroutine path).  All
+                # coroutines share ONE persistent loop (see
+                # _run_coroutine); a blocking call inside async code
+                # stalls every async call on this worker — same caveat
+                # as the reference's async actors.
                 value = self._run_coroutine(value)
         except BaseException as e:
             m["failed"].inc()
@@ -1602,7 +1668,18 @@ class CoreWorker(RpcHost):
                 type(self)._async_exec_loop = loop
                 threading.Thread(target=loop.run_forever,
                                  name="rt-async-exec", daemon=True).start()
-        return asyncio.run_coroutine_threadsafe(coro, loop).result()
+        # carry this exec thread's task context into the coroutine: the
+        # loop thread's threading.local is empty, which would make put()
+        # mint colliding driver-derived ObjectIDs and suppress the
+        # blocked-worker notification.  run_coroutine_threadsafe copies
+        # the CALLING thread's contextvars into the new asyncio.Task, so
+        # the shadow is isolated per call.
+        token = _exec_ctx.set(_ExecShadow(self._exec_tls))
+        try:
+            fut = asyncio.run_coroutine_threadsafe(coro, loop)
+        finally:
+            _exec_ctx.reset(token)
+        return fut.result()
 
     def _materialize_args(self, spec: TaskSpec):
         """Deserialize inline args and batch-fetch ref args, preserving
