@@ -1112,16 +1112,43 @@ class HeadService(RpcHost):
                 f"<td>{_html.escape(str(n.get('labels') or ''))}</td></tr>")
         actors = " ".join(f"{k}: {v}" for k, v in
                           sorted(s["actors_by_state"].items())) or "none"
+        actor_rows = []
+        for a in list(self.actors.values())[:50]:
+            actor_rows.append(
+                f"<tr><td><code>{_html.escape(a.actor_id[:12])}</code></td>"
+                f"<td>{_html.escape(a.name or '')}</td>"
+                f"<td>{_html.escape(str(a.state))}</td>"
+                f"<td><code>{_html.escape((a.node_id or '')[:12])}</code></td>"
+                f"<td>{a.restarts_left}</td></tr>")
+        recent = sorted(self.task_events.values(),
+                        key=lambda r: r.get("running_ts")
+                        or r.get("submitted_ts") or 0, reverse=True)[:30]
+        task_rows = []
+        for r in recent:
+            task_rows.append(
+                f"<tr><td><code>{_html.escape(r.get('task_id', '')[:12])}"
+                f"</code></td><td>{_html.escape(str(r.get('name', '')))}</td>"
+                f"<td>{_html.escape(str(r.get('state', '')))}</td>"
+                f"<td>{_html.escape(str(r.get('error', '') or '')[:80])}"
+                f"</td></tr>")
         html = f"""<!doctype html><html><head><title>ray_tpu</title>
-<style>body{{font-family:sans-serif;margin:2em}}table{{border-collapse:collapse}}
-td,th{{border:1px solid #ccc;padding:4px 10px;text-align:left}}</style></head>
+<style>body{{font-family:sans-serif;margin:2em}}table{{border-collapse:collapse;
+margin-bottom:1.5em}}td,th{{border:1px solid #ccc;padding:4px 10px;
+text-align:left}}</style></head>
 <body><h1>ray_tpu cluster</h1>
 <p>{len(s['nodes'])} node(s) &middot; actors: {actors} &middot;
 {s['num_placement_groups']} placement group(s) &middot;
 <a href="/metrics">/metrics</a> &middot; <a href="/api/state">/api/state</a></p>
+<h2>Nodes</h2>
 <table><tr><th>node</th><th>address</th><th>role</th>
 <th>resources (avail/total)</th><th>labels</th></tr>
-{''.join(rows)}</table></body></html>"""
+{''.join(rows)}</table>
+<h2>Actors ({len(self.actors)})</h2>
+<table><tr><th>id</th><th>name</th><th>state</th><th>node</th>
+<th>restarts left</th></tr>{''.join(actor_rows)}</table>
+<h2>Recent tasks ({len(self.task_events)} tracked)</h2>
+<table><tr><th>id</th><th>name</th><th>state</th><th>error</th></tr>
+{''.join(task_rows)}</table></body></html>"""
         return "text/html", html.encode()
 
     async def rpc_task_events(self, events: List[Dict[str, Any]]):

@@ -144,6 +144,8 @@ class NodeAgent(RpcHost):
         # autoscaler grows the cluster: key -> (demand dict, expiry)
         self._infeasible: Dict[str, Tuple[Dict[str, float], float]] = {}
         self.scalable_shapes: List[ResourceSet] = []
+        # blocked leases whose unblock re-acquire is waiting on capacity
+        self._unblock_pending: Set[str] = set()
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -779,6 +781,9 @@ class NodeAgent(RpcHost):
                 self._grant_token(tok)
 
     def _drain_lease_queue(self):
+        # unblocked-but-unreacquired leases first: they represent work
+        # ALREADY running oversubscribed, ahead of queued new work
+        self._retry_unblocks()
         for sched in [self.local, *self._bundles.values()]:
             for tok in sched.drain():
                 self._grant_token(tok)
@@ -942,31 +947,45 @@ class NodeAgent(RpcHost):
     async def rpc_worker_blocked(self, worker_id: str):
         lease = self._lease_of_worker(worker_id)
         if lease is not None and not lease.blocked:
-            # fungible resources only: TPU/GPU counts map to concrete
-            # chip assignments the lease keeps — donating them would let
-            # a nested task be granted an accelerator count with zero
-            # actual chips behind it
-            donated = ResourceSet({
-                k: v for k, v in lease.resources.to_dict().items()
-                if k not in ("TPU", "GPU")})
-            lease.blocked = True
-            lease.donated = donated
-            for tok in self._lease_sched(lease).release(donated):
-                self._grant_token(tok)
+            # CPU only, exactly the reference's HandleWorkerBlocked:
+            # accelerator counts map to concrete chips the lease keeps,
+            # gang-anchor resources (TPU-<type>-head, node:<id>) must not
+            # double-place while their holder merely waits on objects
+            cpu = lease.resources.to_dict().get("CPU", 0.0)
+            if cpu > 0:
+                donated = ResourceSet({"CPU": cpu})
+                lease.blocked = True
+                lease.donated = donated
+                for tok in self._lease_sched(lease).release(donated):
+                    self._grant_token(tok)
         return {"ok": True}
 
     async def rpc_worker_unblocked(self, worker_id: str):
         lease = self._lease_of_worker(worker_id)
         if lease is not None and lease.blocked:
-            # direct re-acquire, bypassing the FIFO queue: the task is
-            # already running and must not stall behind queued leases.
-            # If the pool can't cover it right now the lease stays
-            # 'blocked' (resources remain donated) — brief oversubscription,
-            # exactly the reference's re-acquire semantics.
-            if self._lease_sched(lease).resources.acquire(lease.donated):
-                lease.blocked = False
-                lease.donated = None
+            self._try_reacquire(lease)
+            if lease.blocked:
+                # pool busy right now: _drain_lease_queue retries on
+                # every release, so the oversubscription window closes
+                # as soon as capacity frees
+                self._unblock_pending.add(lease.lease_id)
         return {"ok": True}
+
+    def _try_reacquire(self, lease: _Lease) -> None:
+        """Direct re-acquire, bypassing the FIFO queue: the task is
+        already running and must not stall behind queued leases."""
+        if self._lease_sched(lease).resources.acquire(lease.donated):
+            lease.blocked = False
+            lease.donated = None
+            self._unblock_pending.discard(lease.lease_id)
+
+    def _retry_unblocks(self) -> None:
+        for lease_id in list(self._unblock_pending):
+            lease = self._leases.get(lease_id)
+            if lease is None or not lease.blocked:
+                self._unblock_pending.discard(lease_id)
+                continue
+            self._try_reacquire(lease)
 
     # ---- misc --------------------------------------------------------------
 

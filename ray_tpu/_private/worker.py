@@ -28,6 +28,7 @@ pins).
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import inspect
 import os
 import queue
@@ -94,17 +95,13 @@ class _ExecShadow:
     invisible; a contextvar carries this shadow instead (isolated per
     asyncio.Task, so interleaved coroutines can't see each other's)."""
 
-    __slots__ = ("task_id", "job_id", "put_index", "num_returns")
+    __slots__ = ("task_id", "job_id", "num_returns")
 
     def __init__(self, src: "_ExecState"):
         self.task_id = src.task_id
         self.job_id = src.job_id
-        self.put_index = src.put_index
         self.num_returns = src.num_returns
 
-
-_exec_ctx: "contextvars.ContextVar" = None  # initialized below
-import contextvars  # noqa: E402 — adjacent to its single use
 
 _exec_ctx = contextvars.ContextVar("rt_exec_shadow", default=None)
 
@@ -564,6 +561,10 @@ class CoreWorker(RpcHost):
         # the deadline starts NOW — the blocked-notification RPC below
         # must not eat into the caller's budget
         deadline = None if timeout is None else time.monotonic() + timeout
+        # NOTE: plasma-stored objects (even locally present ones) also
+        # trigger the notification — the worker has no local index of
+        # plasma contents, and a blocking get's latency dwarfs the
+        # round-trip anyway
         notify = (self.mode == MODE_WORKER and self._exec.task_id
                   and not all(self.memory.ready(r.oid) for r in refs))
         if notify:
@@ -575,18 +576,22 @@ class CoreWorker(RpcHost):
                 self._notify_blocked(False)
 
     def _notify_blocked(self, blocked: bool) -> None:
+        # the RPC stays INSIDE the lock: edge detection and delivery must
+        # serialize, or two exec threads crossing (one leaving get as
+        # another enters) could deliver blocked/unblocked inverted and
+        # wedge the lease's donation state
         with self._block_lock:
             self._block_depth += 1 if blocked else -1
             edge = (self._block_depth == 1) if blocked \
                 else (self._block_depth == 0)
-        if not edge:
-            return
-        try:
-            self.agent.call(
-                "worker_blocked" if blocked else "worker_unblocked",
-                worker_id=self.worker_id, timeout=2.0)
-        except Exception:
-            pass  # agent briefly unreachable: accounting-only feature
+            if not edge:
+                return
+            try:
+                self.agent.call(
+                    "worker_blocked" if blocked else "worker_unblocked",
+                    worker_id=self.worker_id, timeout=2.0)
+            except Exception:
+                pass  # agent briefly unreachable: accounting-only feature
 
     def _get_inner(self, refs: Sequence[ObjectRef],
                    deadline: Optional[float] = None) -> List[Any]:
