@@ -928,7 +928,12 @@ class NodeAgent(RpcHost):
                                 if k not in donated_keys})
         else:
             held = lease.resources
-        for tok in self._lease_sched(lease).release(held):
+        sched = self._lease_sched(lease)
+        sched.resources.release(held)
+        # already-running oversubscribed work re-acquires BEFORE queued
+        # new work gets the freed capacity
+        self._retry_unblocks()
+        for tok in sched.drain():
             self._grant_token(tok)
 
     # ---- blocked-worker resource release -----------------------------------
@@ -946,6 +951,11 @@ class NodeAgent(RpcHost):
 
     async def rpc_worker_blocked(self, worker_id: str):
         lease = self._lease_of_worker(worker_id)
+        if lease is not None:
+            # a worker re-blocking must cancel any stale pending
+            # re-acquire — retrying it would hand CPU to a worker that is
+            # genuinely blocked, starving the nested task it waits on
+            self._unblock_pending.discard(lease.lease_id)
         if lease is not None and not lease.blocked:
             # CPU only, exactly the reference's HandleWorkerBlocked:
             # accelerator counts map to concrete chips the lease keeps,

@@ -85,7 +85,6 @@ def set_global_worker(w: Optional["CoreWorker"]) -> None:
 class _ExecState(threading.local):
     task_id: str = ""
     job_id: str = ""
-    put_index: int = 0
     num_returns: int = 0
 
 

@@ -1,0 +1,107 @@
+"""End-to-end user pipeline: Data -> Train -> checkpoint storage ->
+Serve -> binary ingress query — the full stack the way a user strings
+it together (reference: the doc examples combining ray.data +
+ray.train + ray.serve)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data, serve
+from tests.conftest import force_cpu_jax
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=96 * 1024 * 1024)
+    try:
+        yield ray_tpu
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+
+
+def test_data_train_checkpoint_serve(cluster, tmp_path):
+    force_cpu_jax()
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+    from ray_tpu.train.storage import StorageContext
+
+    # 1. Data: a toy linear regression dataset, y = 3x - 1
+    xs = np.linspace(-1, 1, 256).astype(np.float32)
+    ds = data.from_numpy({"x": xs, "y": 3.0 * xs - 1.0})
+
+    storage_root = str(tmp_path / "store")
+
+    # 2. Train: per-worker loop ingesting its dataset shard, reporting
+    # metrics and a checkpoint with the learned weights
+    def loop(config):
+        import json
+        import os
+
+        from ray_tpu.train import get_context, get_dataset_shard, report
+
+        shard = get_dataset_shard("train")
+        rows = shard.take_all()
+        x = np.array([r["x"] for r in rows], dtype=np.float32)
+        y = np.array([r["y"] for r in rows], dtype=np.float32)
+        w, b = 0.0, 0.0
+        for step in range(200):
+            pred = w * x + b
+            err = pred - y
+            w -= 0.3 * float((err * x).mean())
+            b -= 0.3 * float(err.mean())
+            if step % 50 == 49:
+                ckpt_dir = os.path.join(
+                    config["out"], f"w{get_context().rank}-{step}")
+                os.makedirs(ckpt_dir, exist_ok=True)
+                with open(os.path.join(ckpt_dir, "weights.json"), "w") as f:
+                    json.dump({"w": w, "b": b}, f)
+                report({"loss": float((err ** 2).mean())},
+                       checkpoint=ckpt_dir)
+        return {"w": w, "b": b}
+
+    result = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2),
+        train_loop_config={"out": storage_root},
+        datasets={"train": ds}).fit()
+    final = result.per_worker_final[0]
+    assert abs(final["w"] - 3.0) < 0.1 and abs(final["b"] + 1.0) < 0.1
+
+    # 3. Checkpoint storage: persist the final weights to "remote"
+    # storage and restore on a "fresh host" path
+    sc = StorageContext("memory://e2e/run", "exp")
+    src = tmp_path / "final"
+    src.mkdir()
+    (src / "weights.json").write_text(
+        __import__("json").dumps(final))
+    sc.persist_dir(str(src), "checkpoints/final")
+    restored_dir = sc.fetch_dir("checkpoints/final",
+                                str(tmp_path / "restored"))
+    weights = __import__("json").loads(
+        open(f"{restored_dir}/weights.json").read())
+
+    # 4. Serve: deploy the trained model, query via handle AND the
+    # binary ingress
+    @serve.deployment(name="linreg", num_replicas=2)
+    class LinReg:
+        def __init__(self, w, b):
+            self.w, self.b = w, b
+
+        def __call__(self, x):
+            return {"y": self.w * float(x) + self.b}
+
+    handle = serve.run(LinReg.bind(weights["w"], weights["b"]))
+    y = ray_tpu.get(handle.remote(2.0), timeout=60)["y"]
+    assert abs(y - 5.0) < 0.3
+
+    host, port = serve.start_rpc_ingress()
+    client = serve.RpcIngressClient(host, port)
+    try:
+        assert abs(client.invoke("linreg", 0.0)["y"] + 1.0) < 0.3
+    finally:
+        client.close()
+        serve.stop_rpc_ingress()
+        serve.delete("linreg")
