@@ -32,6 +32,9 @@ _def("scheduler_top_k_fraction", 0.2)  # hybrid policy: top-k random among best
 _def("scheduler_top_k_absolute", 5)    # ref: ray_config_def.h scheduler_top_k_absolute
 _def("scheduler_spread_threshold", 0.5)
 _def("task_retry_delay_ms", 100)
+# how long a bundle reservation queues on the node agent for capacity to
+# free (e.g. lingering task leases) before the head replans elsewhere
+_def("pg_reserve_wait_ms", 2_000)
 _def("actor_creation_retries", 3)
 # --- object store -----------------------------------------------------------
 _def("object_store_memory_bytes", 512 * 1024 * 1024)

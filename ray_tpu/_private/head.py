@@ -45,7 +45,7 @@ PG_PENDING, PG_CREATED, PG_REMOVED = "PENDING", "CREATED", "REMOVED"
 
 class _PgEntry:
     __slots__ = ("pg_id", "bundles", "strategy", "state", "placements",
-                 "name", "waiters", "failure")
+                 "name", "waiters", "failure", "opt_wait_used")
 
     def __init__(self, pg_id: str, bundles: List[Dict[str, float]],
                  strategy: str, name: str):
@@ -57,6 +57,12 @@ class _PgEntry:
         self.name = name
         self.waiters: List[asyncio.Event] = []
         self.failure = ""
+        # an optimistic (totals-based) reservation may head-of-line block
+        # a node's lease queue for pg_reserve_wait_ms — each entry gets
+        # exactly one such waited attempt; once it times out the
+        # unavailability is genuine occupancy, not view staleness, and
+        # retries must not keep stalling unrelated tasks
+        self.opt_wait_used = False
 
     def info(self, nodes: Dict[str, "_NodeEntry"]) -> Dict[str, Any]:
         placements = []
@@ -186,6 +192,13 @@ class HeadService(RpcHost):
         self.task_events: Dict[str, Dict[str, Any]] = {}
         self._metrics_server = None
         self.metrics_port = 0
+        # pending-PG replan wakeups: futures resolved whenever cluster
+        # resources may have freed (heartbeat showing changed availability,
+        # bundle return, node registration) — _schedule_pg waits on these
+        # instead of polling with sleep backoff (reference:
+        # gcs_placement_group_manager.cc SchedulePendingPlacementGroups,
+        # fired on resource-change events from the syncer)
+        self._pg_wake_waiters: List[asyncio.Future] = []
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -364,6 +377,11 @@ class HeadService(RpcHost):
         self._cluster_version += 1
         self.mark_dirty()
         self._broadcast_cluster_view()
+        # fresh capacity invalidates earlier "genuinely occupied"
+        # conclusions: pending PGs may spend a new waited reservation
+        for pg in self.placement_groups.values():
+            pg.opt_wait_used = False
+        self._wake_pending_pgs()
         return {"ok": True, "cluster": self._cluster_view(),
                 "version": self._cluster_version}
 
@@ -395,8 +413,12 @@ class HeadService(RpcHost):
         if entry is None:
             return {"unknown_node": True}
         entry.last_heartbeat = time.monotonic()
-        entry.resources.available = ResourceSet(available)
+        fresh = ResourceSet(available)
+        changed = fresh != entry.resources.available
+        entry.resources.available = fresh
         entry.pending_demands = pending or []
+        if changed:
+            self._wake_pending_pgs()
         return {"cluster": self._cluster_view(), "version": self._cluster_version,
                 "scalable": self._scalable_shapes()}
 
@@ -897,22 +919,44 @@ class HeadService(RpcHost):
                         "return_bundle", pg_id=pg_id, bundle_index=idx)
                 except Exception:
                     pass
+                # update the cached view immediately — the next PG create
+                # must not wait out a heartbeat period to see the freed
+                # capacity (heartbeats remain authoritative and overwrite)
+                node.resources.release(ResourceSet(entry.bundles[idx]))
+        self._wake_pending_pgs()
         return {"ok": True}
 
     async def rpc_list_placement_groups(self):
         return {"placement_groups": [
             e.info(self.nodes) for e in self.placement_groups.values()]}
 
-    def _plan_pg(self, entry: _PgEntry) -> Optional[List[str]]:
+    def _plan_pg(self, entry: _PgEntry,
+                 optimistic: bool = False) -> Optional[List[str]]:
         """Choose a node per bundle per strategy, against a scratch copy of
         the cluster view (all-or-nothing; reference:
-        bundle_scheduling_policy.h pack/spread/strict variants)."""
+        bundle_scheduling_policy.h pack/spread/strict variants).
+
+        ``optimistic`` plans against node totals *minus committed PG
+        bundles* instead of the cached availability view: the view lags
+        reality by up to a heartbeat period (freed task leases, returned
+        bundles), so when no node looks available the head still targets
+        a feasible node and lets the agent-side queued reservation
+        (rpc_reserve_bundle wait_ms) wait out the staleness.  Committed
+        bundles are permanent carve-outs, never staleness — ignoring
+        them would queue unsatisfiable reservations that head-of-line
+        block the node's lease queue."""
         scratch: Dict[str, NodeResources] = {
-            nid: NodeResources.from_dict(
-                {"total": n.resources.total.to_dict(),
-                 "available": n.resources.available.to_dict()})
+            nid: (NodeResources(n.resources.total) if optimistic
+                  else NodeResources.from_dict(
+                      {"total": n.resources.total.to_dict(),
+                       "available": n.resources.available.to_dict()}))
             for nid, n in self.nodes.items()
         }
+        if optimistic:
+            for pg in self.placement_groups.values():
+                for idx, nid in enumerate(pg.placements):
+                    if nid is not None and nid in scratch:
+                        scratch[nid].acquire(ResourceSet(pg.bundles[idx]))
         plan: List[Optional[str]] = []
         used_nodes: List[str] = []
         for idx, bundle in enumerate(entry.bundles):
@@ -949,16 +993,60 @@ class HeadService(RpcHost):
             used_nodes.append(nid)
         return plan
 
+    def _wake_pending_pgs(self) -> None:
+        """Resources may have freed: replan every waiting PG right now."""
+        if not self._pg_wake_waiters:
+            return
+        waiters, self._pg_wake_waiters = self._pg_wake_waiters, []
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result(True)
+
+    async def _wait_pg_event(self, timeout: float) -> bool:
+        """Wait for a resource-release wake, or timeout. True if woken."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pg_wake_waiters.append(fut)
+        try:
+            await asyncio.wait_for(fut, timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+        finally:
+            if fut in self._pg_wake_waiters:
+                self._pg_wake_waiters.remove(fut)
+
     async def _schedule_pg(self, entry: _PgEntry):
         """Keep trying until reserved or removed.  Like the reference, a
         group that doesn't currently fit stays PENDING indefinitely (the
-        autoscaler is what resolves persistent infeasibility)."""
+        autoscaler is what resolves persistent infeasibility).
+
+        Retries are event-driven: a failed attempt parks on
+        _wait_pg_event and is woken by heartbeats/bundle returns/node
+        registrations, with sleep backoff only as the fallback."""
         delay = 0.05
         while entry.state == PG_PENDING \
                 and self.placement_groups.get(entry.pg_id) is entry:
             plan = self._plan_pg(entry)
+            # an availability-backed plan always reserves with a wait:
+            # the view can be stale the other way (shows available, node
+            # briefly isn't — lingering leases), and a queued reservation
+            # grants the moment the agent reclaims them
+            wait_ms = int(config.pg_reserve_wait_ms)
+            if plan is None:
+                # the availability view may simply be stale (lingering
+                # leases just returned, heartbeat not in yet): target
+                # feasible nodes and let the reservation queue there —
+                # but a totals-based plan can also target genuinely
+                # occupied capacity, so only the FIRST such attempt may
+                # block the node's lease queue for the full wait
+                plan = self._plan_pg(entry, optimistic=True)
+                if entry.opt_wait_used:
+                    wait_ms = 0
+                elif plan is not None:
+                    entry.opt_wait_used = True
+            ok = False
             if plan is not None:
-                ok = await self._reserve_pg(entry, plan)
+                ok, newly = await self._reserve_pg(entry, plan, wait_ms)
                 if ok:
                     removed = entry.state != PG_PENDING
                     # a plan node may have died between the last reserve
@@ -979,17 +1067,28 @@ class HeadService(RpcHost):
                             return
                         entry.placements = [None] * len(entry.bundles)
                         continue  # replan from scratch
+                    # reflect the reservation in the cached view at once
+                    # (heartbeats remain authoritative and overwrite);
+                    # only bundles reserved by THIS attempt — pre-existing
+                    # ones were accounted when first committed
+                    for idx in newly:
+                        node = self.nodes.get(plan[idx])
+                        if node is not None:
+                            node.resources.acquire(
+                                ResourceSet(entry.bundles[idx]))
                     entry.placements = plan
                     entry.state = PG_CREATED
                     self.mark_dirty()
                     entry.wake()
                     return
-            await asyncio.sleep(delay)
-            delay = min(delay * 2, 1.0)
+            woke = await self._wait_pg_event(delay)
+            delay = 0.05 if woke else min(delay * 2, 1.0)
 
-    async def _reserve_pg(self, entry: _PgEntry, plan: List[str]) -> bool:
+    async def _reserve_pg(self, entry: _PgEntry, plan: List[str],
+                          wait_ms: int = 0):
         """Reserve every bundle; roll back on any failure (all-or-nothing —
-        the TPU-slice gang atomicity guarantee)."""
+        the TPU-slice gang atomicity guarantee).  Returns
+        (ok, newly_reserved_bundle_indices)."""
         newly_reserved: List[int] = []
         for idx, nid in enumerate(plan):
             node = self.nodes.get(nid)
@@ -998,9 +1097,16 @@ class HeadService(RpcHost):
             try:
                 r = await self._node_client(node).call(
                     "reserve_bundle", pg_id=entry.pg_id, bundle_index=idx,
-                    resources=entry.bundles[idx])
+                    resources=entry.bundles[idx], wait_ms=wait_ms)
             except Exception:
                 r = {"ok": False}
+                # the RPC failed on OUR side (connection drop) but the
+                # agent-side handler may still be waiting — or may grant
+                # later; make sure nothing stays carved out for an
+                # attempt we are abandoning (best-effort: the agent also
+                # rolls back grants whose caller connection closed)
+                asyncio.ensure_future(self._abort_bundle_reservation(
+                    nid, entry.pg_id, idx))
             if not r.get("ok"):
                 break
             if not r.get("already"):
@@ -1008,7 +1114,7 @@ class HeadService(RpcHost):
                 # back; pre-existing ones carry live workloads
                 newly_reserved.append(idx)
         else:
-            return True
+            return True, newly_reserved
         for idx in newly_reserved:
             node = self.nodes.get(plan[idx])
             if node is not None:
@@ -1017,7 +1123,19 @@ class HeadService(RpcHost):
                         "return_bundle", pg_id=entry.pg_id, bundle_index=idx)
                 except Exception:
                     pass
-        return False
+        return False, []
+
+    async def _abort_bundle_reservation(self, nid: str, pg_id: str,
+                                        bundle_index: int):
+        node = self.nodes.get(nid)
+        if node is None:
+            return
+        try:
+            await self._node_client(node).call(
+                "cancel_bundle_reservation", pg_id=pg_id,
+                bundle_index=bundle_index)
+        except Exception:
+            pass
 
     async def _on_pg_node_dead(self, node_id: str):
         """Bundles on a dead node are re-reserved elsewhere (non-strict) or

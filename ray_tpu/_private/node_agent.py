@@ -72,16 +72,21 @@ def _is_hard_strategy(strategy: Dict[str, Any]) -> bool:
 
 class _Lease:
     __slots__ = ("lease_id", "worker", "resources", "bundle_key", "seq",
-                 "tpu_chips", "blocked", "donated")
+                 "tpu_chips", "blocked", "donated", "owner_conn")
 
     def __init__(self, lease_id: str, worker: _Worker, resources: ResourceSet,
-                 bundle_key: str = "", seq: int = 0):
+                 bundle_key: str = "", seq: int = 0, owner_conn=None):
         self.lease_id = lease_id
         self.worker = worker
         self.resources = resources
         self.bundle_key = bundle_key
         self.seq = seq  # grant order; the OOM policy kills newest first
         self.tpu_chips: List[int] = []  # chip indices assigned to this lease
+        # the connection the grant went out on — lets the agent push a
+        # reclaim request to the owner when new demand queues behind
+        # idle-lingering leases (reference: the raylet's lease revocation
+        # via ReleaseUnusedWorkers)
+        self.owner_conn = owner_conn
         # True while the leased worker is blocked in a get(): its
         # fungible resources are returned to the pool so nested tasks
         # can run (reference: node_manager HandleWorkerBlocked/Unblocked
@@ -146,6 +151,20 @@ class NodeAgent(RpcHost):
         self.scalable_shapes: List[ResourceSet] = []
         # blocked leases whose unblock re-acquire is waiting on capacity
         self._unblock_pending: Set[str] = set()
+        # set whenever resources free up: triggers an immediate (coalesced)
+        # heartbeat so the head's availability view refreshes in ~ms, not a
+        # full heartbeat period — pending placement groups replan on it
+        # (reference: gcs_placement_group_manager.cc retries pending groups
+        # on resource-change notifications from the syncer)
+        self._hb_wake = asyncio.Event()
+        self._last_reclaim = 0.0  # rate limit for _reclaim_idle_leases
+        # queued lease requests by client request id, so owners can
+        # cancel requests whose demand drained before a grant
+        # (reference: node_manager.proto CancelWorkerLease)
+        self._lease_req_tokens: Dict[str, Tuple[object, LocalScheduler]] = {}
+        # queued bundle reservations by bundle key, so the head can
+        # cancel a waited reservation whose RPC failed on its side
+        self._reserve_tokens: Dict[str, Tuple[object, LocalScheduler]] = {}
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -303,7 +322,14 @@ class NodeAgent(RpcHost):
                                          reply.get("scalable"))
             except Exception:
                 pass  # head unreachable (possibly restarting) — keep trying
-            await asyncio.sleep(period)
+            try:
+                await asyncio.wait_for(self._hb_wake.wait(), period)
+            except asyncio.TimeoutError:
+                continue
+            # resources freed: coalesce a burst of releases into one
+            # off-cycle heartbeat, capping the extra rate at ~20/s
+            await asyncio.sleep(0.05)
+            self._hb_wake.clear()
 
     # ---- object store RPCs (PlasmaClient protocol) -------------------------
 
@@ -619,17 +645,63 @@ class NodeAgent(RpcHost):
     # ---- placement group bundles -------------------------------------------
 
     async def rpc_reserve_bundle(self, pg_id: str, bundle_index: int,
-                                 resources: Dict[str, float]):
+                                 resources: Dict[str, float],
+                                 wait_ms: int = 0, _conn=None):
         """Atomically carve a bundle's resources out of the node pool
-        (reference: node_manager.proto PrepareBundleResources)."""
+        (reference: node_manager.proto PrepareBundleResources).
+
+        With ``wait_ms`` > 0 a reservation that cannot be satisfied right
+        now joins the FIFO lease queue instead of failing: the moment a
+        lingering task lease returns (worker.py _LEASE_LINGER_S) the
+        freed capacity grants the reservation — placement groups preempt
+        the linger cache event-driven rather than the head polling."""
         key = f"{pg_id}:{bundle_index}"
         if key in self._bundles:
             return {"ok": True, "already": True}
         demand = ResourceSet(resources)
-        if not self.resources.acquire(demand):
+        if self.local.try_acquire(demand):
+            self._bundles[key] = LocalScheduler(NodeResources(demand))
+            return {"ok": True}
+        if wait_ms <= 0 or not self.resources.is_feasible(demand):
             return {"ok": False, "error": "insufficient resources"}
+        status = await self._queue_for_resources(
+            self.local, demand, wait_ms / 1000.0,
+            cancel_key=key, registry=self._reserve_tokens)
+        if status != "granted":
+            return {"ok": False, "error": "insufficient resources"
+                    if status == "timeout" else "canceled"}
+        if _conn is not None and _conn.writer.is_closing():
+            # the head that asked is gone and cannot learn of this grant;
+            # its rollback only covers acknowledged reservations — give
+            # the capacity back instead of leaking a phantom carve-out
+            for tok in self.local.release(demand):
+                self._grant_token(tok)
+            return {"ok": False, "error": "caller disconnected"}
         self._bundles[key] = LocalScheduler(NodeResources(demand))
         return {"ok": True}
+
+    async def rpc_cancel_bundle_reservation(self, pg_id: str,
+                                            bundle_index: int):
+        """Head-side reserve RPC failed (connection drop mid-wait): drop
+        the queued reservation, or return the bundle if it already
+        granted — either way no capacity stays carved out for a
+        reservation the head gave up on."""
+        key = f"{pg_id}:{bundle_index}"
+        entry = self._reserve_tokens.get(key)
+        if entry is not None:
+            token, sched = entry
+            waiter = self._lease_waiters.pop(token, None)
+            if waiter is not None:
+                fut = waiter[0]
+                _found, granted = sched.cancel(token)
+                for tok in granted:
+                    self._grant_token(tok)
+                if not fut.done():
+                    fut.set_result("canceled")
+                return {"ok": True}
+        if key in self._bundles:
+            return await self.rpc_return_bundle(pg_id, bundle_index)
+        return {"ok": False}
 
     async def rpc_return_bundle(self, pg_id: str, bundle_index: int):
         key = f"{pg_id}:{bundle_index}"
@@ -651,6 +723,7 @@ class NodeAgent(RpcHost):
                     pass
         for tok in self.local.release(sched.resources.total):
             self._grant_token(tok)
+        self._hb_wake.set()
         return {"ok": True}
 
     def _sched_for(self, ts: TaskSpec):
@@ -663,7 +736,9 @@ class NodeAgent(RpcHost):
 
     # ---- lease protocol ----------------------------------------------------
 
-    async def rpc_request_lease(self, spec: Dict[str, Any], grant_only: bool = False):
+    async def rpc_request_lease(self, spec: Dict[str, Any],
+                                grant_only: bool = False, req_id: str = "",
+                                _conn=None):
         """Grant a worker lease for the task's resource shape.
 
         Replies: {"granted": {...}} | {"spillback": {...}} | {"error": ...}
@@ -673,7 +748,7 @@ class NodeAgent(RpcHost):
         ts = TaskSpec.from_wire(spec)
         demand = ts.resource_set()
         if ts.placement_group_id:
-            return await self._request_bundle_lease(ts, demand)
+            return await self._request_bundle_lease(ts, demand, _conn, req_id)
         if not grant_only:
             cluster = {
                 nid: NodeResources.from_dict(
@@ -717,13 +792,15 @@ class NodeAgent(RpcHost):
         if not self.resources.is_feasible(demand):
             return {"error": "infeasible",
                     "error_str": f"node cannot satisfy {demand.to_dict()}"}
-        return await self._acquire_and_grant(self.local, demand, "", ts)
+        return await self._acquire_and_grant(self.local, demand, "", ts, _conn,
+                                             req_id)
 
     def _demand_is_scalable(self, demand: ResourceSet) -> bool:
         """True if some autoscaler-launchable node type could fit this."""
         return any(shape.fits(demand) for shape in self.scalable_shapes)
 
-    async def _request_bundle_lease(self, ts: TaskSpec, demand: ResourceSet):
+    async def _request_bundle_lease(self, ts: TaskSpec, demand: ResourceSet,
+                                    conn=None, req_id: str = ""):
         sched, key = self._sched_for(ts)
         if sched is None:
             return {"error": "bundle not reserved",
@@ -733,40 +810,117 @@ class NodeAgent(RpcHost):
             return {"error": "infeasible",
                     "error_str": f"demand {demand.to_dict()} exceeds bundle "
                                  f"{key} capacity"}
-        return await self._acquire_and_grant(sched, demand, key, ts)
+        return await self._acquire_and_grant(sched, demand, key, ts, conn,
+                                             req_id)
 
-    async def _acquire_and_grant(self, sched: LocalScheduler,
-                                 demand: ResourceSet, bundle_key: str,
-                                 ts: Optional[TaskSpec] = None):
-        if sched.try_acquire(demand):
-            return await self._grant_safe(sched, demand, bundle_key, ts)
-        # queue FIFO-with-resources
+    async def _queue_for_resources(self, sched: LocalScheduler,
+                                   demand: ResourceSet, wait_s: float,
+                                   cancel_key: Optional[str] = None,
+                                   registry: Optional[Dict] = None) -> str:
+        """Enqueue demand on a scheduler's FIFO and wait for it.
+
+        Returns "granted" (the demand's resources are acquired — note a
+        bundle-removal wake also reports granted; callers re-check their
+        bundle), "canceled" (dropped via cancel_key, nothing acquired),
+        or "timeout" (nothing acquired).  Handles the
+        granted-between-timeout-and-cancel race in one place for lease
+        requests and bundle reservations alike."""
         token = object()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._lease_waiters[token] = (fut, demand, sched)
+        if registry is not None and cancel_key is not None:
+            registry[cancel_key] = (token, sched)
         sched.enqueue(token, demand)
+        if sched is self.local:
+            # only node-pool demand benefits from reclaiming lingering
+            # leases; bundle-internal queues resolve within the bundle
+            self._reclaim_idle_leases()
         try:
-            await asyncio.wait_for(fut, config.worker_lease_timeout_ms / 1000.0)
+            res = await asyncio.wait_for(fut, wait_s)
         except asyncio.TimeoutError:
             found, granted = sched.cancel(token)
             self._lease_waiters.pop(token, None)
             for tok in granted:
                 self._grant_token(tok)
-            if not found and fut.done() and not fut.cancelled():
-                if bundle_key and bundle_key not in self._bundles:
-                    # woken because the bundle was removed, not granted
-                    return {"error": "bundle not reserved",
-                            "error_str": "placement group removed while queued"}
-                # granted between timeout and cancel; resources are ours
-                return await self._grant_safe(sched, demand, bundle_key, ts)
-            # if not found and fut is cancelled, _grant_token already gave
-            # the acquired resources back — nothing more to do here
+            if not found and fut.done() and not fut.cancelled() \
+                    and fut.result() != "canceled":
+                return "granted"  # won the race; resources are ours
+            # if fut is cancelled, _grant_token already gave the
+            # acquired resources back — nothing more to do here
+            return "timeout"
+        finally:
+            if registry is not None and cancel_key is not None:
+                registry.pop(cancel_key, None)
+        return "canceled" if res == "canceled" else "granted"
+
+    async def _acquire_and_grant(self, sched: LocalScheduler,
+                                 demand: ResourceSet, bundle_key: str,
+                                 ts: Optional[TaskSpec] = None, conn=None,
+                                 req_id: str = ""):
+        if sched.try_acquire(demand):
+            return await self._grant_safe(sched, demand, bundle_key, ts, conn)
+        status = await self._queue_for_resources(
+            sched, demand, config.worker_lease_timeout_ms / 1000.0,
+            cancel_key=req_id or None, registry=self._lease_req_tokens)
+        if status == "canceled":
+            # owner's demand drained before a grant; nothing was acquired
+            return {"error": "canceled",
+                    "error_str": "lease request canceled by owner"}
+        if status == "timeout":
             return {"error": "lease timeout",
                     "error_str": "timed out waiting for resources"}
         if bundle_key and bundle_key not in self._bundles:
+            # woken because the bundle was removed, not granted
             return {"error": "bundle not reserved",
                     "error_str": "placement group removed while queued"}
-        return await self._grant_safe(sched, demand, bundle_key, ts)
+        return await self._grant_safe(sched, demand, bundle_key, ts, conn)
+
+    async def rpc_cancel_lease_request(self, req_id: str):
+        """Owner-side demand for a queued lease request drained: drop it
+        from the FIFO so it is never granted into an idle linger
+        (reference: node_manager.proto CancelWorkerLease)."""
+        entry = self._lease_req_tokens.pop(req_id, None)
+        if entry is None:
+            return {"ok": False}  # unknown, or already granted
+        token, sched = entry
+        waiter = self._lease_waiters.pop(token, None)
+        if waiter is None:
+            return {"ok": False}  # granted in the meantime
+        fut = waiter[0]
+        _found, granted = sched.cancel(token)
+        for tok in granted:
+            self._grant_token(tok)
+        if not fut.done():
+            fut.set_result("canceled")
+        return {"ok": True}
+
+    def _reclaim_idle_leases(self) -> None:
+        """Demand just queued behind granted leases: ask every lease's
+        owner to hand back leases with nothing in flight RIGHT NOW
+        instead of letting them sit out the owner-side linger window
+        (worker.py _LEASE_LINGER_S).  Best-effort oneway pushes; an owner
+        that just assigned a task simply ignores the request.  This is
+        what keeps placement-group reservation latency flat right after
+        a task burst (reference: the raylet revoking unused workers via
+        ReleaseUnusedWorkers when demand arrives)."""
+        now = time.monotonic()
+        if now - self._last_reclaim < 0.05:  # coalesce bursts of queuers
+            return
+        self._last_reclaim = now
+        conns = {id(l.owner_conn): l.owner_conn
+                 for l in self._leases.values()
+                 if l.owner_conn is not None}
+
+        payload = {"agent": [self.host, self.port]}
+
+        async def _push(conn):
+            try:
+                await conn.push("reclaim_idle_leases", payload)
+            except Exception:
+                pass
+
+        for conn in conns.values():
+            asyncio.ensure_future(_push(conn))
 
     def _grant_token(self, token: object):
         entry = self._lease_waiters.pop(token, None)
@@ -790,11 +944,11 @@ class NodeAgent(RpcHost):
 
     async def _grant_safe(self, sched: LocalScheduler, demand: ResourceSet,
                           bundle_key: str = "",
-                          ts: Optional[TaskSpec] = None):
+                          ts: Optional[TaskSpec] = None, conn=None):
         """_grant, releasing the already-acquired resources if it raises
         unexpectedly — a grant-path bug must not leak node capacity."""
         try:
-            return await self._grant(sched, demand, bundle_key, ts)
+            return await self._grant(sched, demand, bundle_key, ts, conn)
         except Exception as exc:
             for tok in sched.release(demand):
                 self._grant_token(tok)
@@ -802,7 +956,8 @@ class NodeAgent(RpcHost):
                     "error_str": f"{type(exc).__name__}: {exc}"}
 
     async def _grant(self, sched: LocalScheduler, demand: ResourceSet,
-                     bundle_key: str = "", ts: Optional[TaskSpec] = None):
+                     bundle_key: str = "", ts: Optional[TaskSpec] = None,
+                     conn=None):
         # `demand` resources are already acquired from `sched`
         renv = ts.runtime_env if ts is not None else {}
         try:
@@ -821,7 +976,7 @@ class NodeAgent(RpcHost):
         self._lease_counter += 1
         lease_id = f"{self.node_id[:12]}-{self._lease_counter}"
         lease = _Lease(lease_id, worker, demand, bundle_key,
-                       seq=self._lease_counter)
+                       seq=self._lease_counter, owner_conn=conn)
         n_tpu = int(demand.to_dict().get("TPU", 0))
         take = min(n_tpu, len(self._free_tpu_chips))
         if take > 0:
@@ -935,6 +1090,7 @@ class NodeAgent(RpcHost):
         self._retry_unblocks()
         for tok in sched.drain():
             self._grant_token(tok)
+        self._hb_wake.set()
 
     # ---- blocked-worker resource release -----------------------------------
     # A worker blocked in get() inside a task hands its lease's resources

@@ -135,11 +135,13 @@ class _LineageEntry:
 
 class _Lease:
     __slots__ = ("lease_id", "worker_id", "addr", "agent_addr", "inflight",
-                 "linger_handle", "dead", "failed_head", "tpu_chips")
+                 "linger_handle", "dead", "failed_head", "tpu_chips",
+                 "in_bundle")
 
     def __init__(self, lease_id: str, worker_id: str, addr: Tuple[str, int],
                  agent_addr: Tuple[str, int],
-                 tpu_chips: Optional[List[int]] = None):
+                 tpu_chips: Optional[List[int]] = None,
+                 in_bundle: bool = False):
         self.lease_id = lease_id
         self.worker_id = worker_id
         self.addr = addr
@@ -156,10 +158,15 @@ class _Lease:
         self.dead = False
         # snapshotted at death: the one task that was actually executing
         self.failed_head: Optional[_TaskState] = None
+        # granted out of a PG bundle's reserved capacity: returning it
+        # frees bundle-internal capacity only, so node-pool reclaim
+        # pushes must not evict it
+        self.in_bundle = in_bundle
 
 
 class _SchedState:
-    __slots__ = ("pending", "leases", "inflight_requests", "svc_s")
+    __slots__ = ("pending", "leases", "inflight_requests", "svc_s",
+                 "request_agents", "req_counter")
 
     def __init__(self):
         self.pending: deque = deque()
@@ -168,6 +175,13 @@ class _SchedState:
         # EWMA of this scheduling class's push round-trip time; unmeasured
         # classes spread depth-1 across workers, proven-short ones pipeline
         self.svc_s: Optional[float] = None
+        # outstanding lease requests: req_id -> agent addr currently asked.
+        # When pending drains, the owner cancels these so stale queued
+        # requests don't hold the agent's FIFO — each would otherwise be
+        # granted, linger idle, and stall queued demand behind it
+        # (reference: CancelWorkerLease in node_manager.proto)
+        self.request_agents: Dict[str, Tuple[str, int]] = {}
+        self.req_counter = 0
 
 
 class _ActorState:
@@ -339,9 +353,30 @@ class CoreWorker(RpcHost):
         addr = (addr[0], addr[1])
         c = self._agent_clients.get(addr)
         if c is None or c.dead:
-            c = RpcClient(addr[0], addr[1], label=f"agent-{addr[1]}")
+            c = RpcClient(addr[0], addr[1], label=f"agent-{addr[1]}",
+                          on_push=self._on_agent_push)
             self._agent_clients[addr] = c
         return c
+
+    def _on_agent_push(self, method: str, payload: Dict[str, Any]):
+        """Oneway pushes from a node agent (runs on the IO loop)."""
+        if method == "reclaim_idle_leases":
+            # demand queued behind our leases on THAT agent: return its
+            # leases with nothing in flight NOW instead of after the
+            # linger window — a lease we just assigned work to has
+            # inflight tasks and is skipped (no correctness race).
+            # Leases on other agents keep their warm linger cache.
+            agent = tuple(payload.get("agent") or ())
+            for state in self._sched.values():
+                for lease in list(state.leases):
+                    if lease.inflight or lease.dead or lease.in_bundle:
+                        continue
+                    if agent and tuple(lease.agent_addr) != agent:
+                        continue
+                    if lease.linger_handle is not None:
+                        lease.linger_handle.cancel()
+                        lease.linger_handle = None
+                    self._spawn(self._return_lease(state, lease))
 
     def shutdown(self):
         # flush buffered task events before tearing the IO plane down —
@@ -998,8 +1033,16 @@ class CoreWorker(RpcHost):
             task = state.pending.popleft()
             self._assign(state, lease, task)
         if not state.pending:
-            # no demand: linger-return every idle lease (a lease granted
-            # after the queue drained would otherwise pin resources forever)
+            # no demand: cancel outstanding lease requests — a stale
+            # queued request would be granted later, linger idle, and
+            # stall demand queued behind it on the agent (reference:
+            # CancelWorkerLease on lease_policy mismatch/drain)
+            if state.request_agents:
+                cancels, state.request_agents = state.request_agents, {}
+                for rid, addr in cancels.items():
+                    self._spawn(self._cancel_lease_request(rid, addr))
+            # linger-return every idle lease (a lease granted after the
+            # queue drained would otherwise pin resources forever)
             for lease in state.leases:
                 if not lease.inflight and not lease.dead \
                         and lease.linger_handle is None:
@@ -1011,6 +1054,13 @@ class CoreWorker(RpcHost):
         for _ in range(max(0, min(deficit, capacity))):
             state.inflight_requests += 1
             self._spawn(self._request_lease(state, state.pending[0].spec))
+
+    async def _cancel_lease_request(self, rid: str, addr: Tuple[str, int]):
+        try:
+            c = await self._aclient_agent(addr)
+            await c.oneway("cancel_lease_request", req_id=rid)
+        except Exception:
+            pass
 
     async def _pg_bundle_addr(self, pg_id: str, bundle_index: int,
                               refresh: bool = False):
@@ -1035,16 +1085,20 @@ class CoreWorker(RpcHost):
         return "ok", (p["addr"][0], p["addr"][1])
 
     async def _request_lease(self, state: _SchedState, spec: TaskSpec):
+        rid = ""
         try:
             if spec.placement_group_id:
                 await self._request_pg_lease(state, spec)
                 return
+            state.req_counter += 1
+            rid = f"{self.worker_id[:12]}-{state.req_counter}"
             agent_addr = self.agent_addr
             for _hop in range(8):
+                state.request_agents[rid] = agent_addr
                 try:
                     c = await self._aclient_agent(agent_addr)
                     reply = await c.call(
-                        "request_lease", spec=spec.to_wire(),
+                        "request_lease", spec=spec.to_wire(), req_id=rid,
                         timeout=config.worker_lease_timeout_ms / 1000.0 + 10.0)
                 except (ConnectionLost, RpcError):
                     if agent_addr == self.agent_addr:
@@ -1072,10 +1126,14 @@ class CoreWorker(RpcHost):
                     while state.pending:
                         self._fail_task(state.pending.popleft(), err)
                     return
+                if reply.get("error") == "canceled":
+                    return  # we canceled it: demand drained
                 # lease timeout: retry while there is still demand
                 if not state.pending:
                     return
         finally:
+            if rid:
+                state.request_agents.pop(rid, None)
             state.inflight_requests -= 1
             self._pump(state)
 
@@ -1111,7 +1169,7 @@ class CoreWorker(RpcHost):
                 g = reply["granted"]
                 lease = _Lease(g["lease_id"], g["worker_id"],
                                (g["addr"][0], g["addr"][1]), addr,
-                               tpu_chips=g.get("tpu_chips"))
+                               tpu_chips=g.get("tpu_chips"), in_bundle=True)
                 state.leases.append(lease)
                 return
             if reply.get("error") == "bundle not reserved":
