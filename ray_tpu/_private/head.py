@@ -84,7 +84,7 @@ class _ActorEntry:
     __slots__ = ("actor_id", "spec_wire", "state", "node_id", "worker_id",
                  "addr", "instance", "restarts_left", "name", "waiters",
                  "death_cause", "kill_requested", "sched_gen", "sched_node",
-                 "sched_task")
+                 "sched_task", "method_num_returns")
 
     def __init__(self, actor_id: str, spec_wire: Dict[str, Any], name: str,
                  max_restarts: int):
@@ -106,6 +106,9 @@ class _ActorEntry:
         self.sched_gen = 0
         self.sched_node: str = ""
         self.sched_task: Optional[asyncio.Task] = None
+        # @method(num_returns=...) annotations, served to get_actor so a
+        # handle fetched by name streams the same as the creating handle
+        self.method_num_returns: Dict[str, Any] = {}
 
     def info(self) -> Dict[str, Any]:
         return {
@@ -268,7 +271,8 @@ class HeadService(RpcHost):
                  "addr": list(a.addr) if a.addr else None,
                  "instance": a.instance, "restarts_left": a.restarts_left,
                  "name": a.name, "death_cause": a.death_cause,
-                 "kill_requested": a.kill_requested}
+                 "kill_requested": a.kill_requested,
+                 "method_num_returns": a.method_num_returns}
                 for a in self.actors.values()],
             "placement_groups": [
                 {"pg_id": p.pg_id, "bundles": p.bundles,
@@ -332,6 +336,7 @@ class HeadService(RpcHost):
             entry.instance = a["instance"]
             entry.restarts_left = a["restarts_left"]
             entry.death_cause = a["death_cause"]
+            entry.method_num_returns = dict(a.get("method_num_returns") or {})
             entry.kill_requested = a["kill_requested"]
             self.actors[entry.actor_id] = entry
         for p in snap.get("placement_groups", []):
@@ -559,7 +564,8 @@ class HeadService(RpcHost):
 
     # ---- actor manager -----------------------------------------------------
 
-    async def rpc_create_actor(self, spec: Dict[str, Any], name: str = ""):
+    async def rpc_create_actor(self, spec: Dict[str, Any], name: str = "",
+                               method_num_returns: Optional[Dict] = None):
         ts = TaskSpec.from_wire(spec)
         existing = self.actors.get(ts.actor_id)
         if existing is not None:
@@ -572,6 +578,7 @@ class HeadService(RpcHost):
                 raise RpcError(f"actor name {name!r} already taken")
             self.named_actors[name] = ts.actor_id
         entry = _ActorEntry(ts.actor_id, spec, name, ts.max_restarts)
+        entry.method_num_returns = dict(method_num_returns or {})
         self.actors[ts.actor_id] = entry
         self.mark_dirty()
         self._spawn_scheduler(entry)
@@ -603,7 +610,10 @@ class HeadService(RpcHost):
         aid = self.named_actors.get(name)
         if aid is None:
             return {"found": False}
-        return {"found": True, "actor_id": aid}
+        entry = self.actors.get(aid)
+        return {"found": True, "actor_id": aid,
+                "method_num_returns":
+                    entry.method_num_returns if entry else {}}
 
     async def rpc_list_actors(self):
         return {"actors": [a.info() for a in self.actors.values()]}

@@ -1,0 +1,151 @@
+"""Streaming generator returns (``num_returns="streaming"``).
+
+TPU-native re-imagining of the reference's streaming generator machinery
+(reference: python/ray/_raylet.pyx:272 ObjectRefGenerator, :1104
+execute_streaming_generator_*; core_worker.proto
+ReportGeneratorItemReturns): a task or actor method whose body is a
+generator reports each yielded value to its owner AS IT IS PRODUCED —
+the owner consumes items while the task is still running, which is what
+token-streaming inference (the TPU serving shape) and streaming data
+ingestion ride on.
+
+Design differences from the reference, on purpose:
+  * items ride the already-open owner->worker RPC connection as oneway
+    server->client pushes (ordered by TCP), not a separate
+    ReportGeneratorItemReturns RPC with acks — one in-order byte stream
+    replaces the reference's item-index reordering buffer;
+  * item ObjectIDs reuse the deterministic return-index scheme
+    (ObjectID.from_index(task_id, i+1)), so a streamed item IS an
+    ordinary owned object afterwards: plasma-stored when large, inline
+    in the owner's memory store when small, gettable/borrowable like any
+    return value;
+  * backpressure is the transport's (TCP + the consumer draining);
+    the reference's _generator_backpressure_num_objects is not needed
+    for the target workloads (small token/batch items).
+
+Known limits (v1, documented not hidden): streaming tasks are not
+automatically retried on worker death (consumed prefixes cannot be
+un-consumed; the error surfaces at the next ``__next__``), and an
+``ObjectRefGenerator`` cannot be pickled or passed to another task.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu._private.object_ref import ObjectRef
+
+STREAMING = -1  # TaskSpec.num_returns wire value for streaming tasks
+
+
+class StreamState:
+    """Owner-side record of one in-flight generator task's stream."""
+
+    __slots__ = ("arrived", "total", "error", "event")
+
+    def __init__(self):
+        self.arrived = 0                 # contiguous items reported so far
+        self.total: Optional[int] = None  # set when the task finishes
+        self.error: Optional[BaseException] = None
+        self.event = threading.Event()   # wakes blocked consumers
+
+    def wake(self) -> None:
+        self.event.set()
+
+
+class ObjectRefGenerator:
+    """Iterator of ObjectRefs for a streaming task's yields.
+
+    Each ``__next__`` blocks until the worker has reported item i, then
+    returns an ObjectRef resolving to it (already local to the owner:
+    inline bytes in the memory store, or a recorded plasma location).
+    Ends with StopIteration after the task finishes and every yielded
+    item has been handed out; a task error raises at the position where
+    the stream broke (items before it stay consumable).
+    """
+
+    def __init__(self, worker, task_id: str):
+        self._worker = worker
+        self._task_id = task_id
+        self._next = 0
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> ObjectRef:
+        return self.next_ref(timeout=None)
+
+    @property
+    def task_id(self) -> str:
+        return self._task_id
+
+    def next_ref(self, timeout: Optional[float] = None) -> ObjectRef:
+        """__next__ with an optional timeout (raises TimeoutError)."""
+        import time as _time
+
+        w = self._worker
+        s = w._streams.get(self._task_id)
+        if s is None:
+            raise StopIteration
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        # consuming inside a task blocks this worker like get() does:
+        # donate the lease's CPU so the producer can schedule on a full
+        # node (reference: HandleWorkerBlocked — same rule as get)
+        notify = self._should_notify(s)
+        if notify:
+            w._notify_blocked(True)
+        try:
+            while True:
+                if self._next < s.arrived:
+                    tid = TaskID.from_hex(self._task_id)
+                    oid = ObjectID.from_index(tid, self._next + 1).hex()
+                    self._next += 1
+                    return ObjectRef(oid, owner_addr=w.address)
+                if s.error is not None:
+                    raise s.error
+                if s.total is not None and self._next >= s.total:
+                    w._streams.pop(self._task_id, None)
+                    raise StopIteration
+                s.event.clear()
+                # re-check after clear: the producer may have fired
+                # between the checks above and the clear (lost-wake guard)
+                if (self._next < s.arrived or s.error is not None
+                        or s.total is not None):
+                    continue
+                remaining = None if deadline is None \
+                    else deadline - _time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"no streamed item within {timeout}s")
+                s.event.wait(min(0.5, remaining) if remaining is not None
+                             else 0.5)
+        finally:
+            if notify:
+                w._notify_blocked(False)
+
+    def _should_notify(self, s: StreamState) -> bool:
+        from ray_tpu._private.worker import MODE_WORKER
+
+        w = self._worker
+        return (w.mode == MODE_WORKER and bool(w._exec.task_id)
+                and not (self._next < s.arrived or s.total is not None
+                         or s.error is not None))
+
+    def completed(self) -> bool:
+        s = self._worker._streams.get(self._task_id)
+        return s is None or s.total is not None or s.error is not None
+
+    def __reduce__(self):
+        raise TypeError(
+            "ObjectRefGenerator cannot be pickled or passed to tasks; "
+            "consume it in the owning process")
+
+    def __del__(self):
+        # stop accepting items for an abandoned stream; already-arrived
+        # unconsumed items are released with the owner's memory store
+        try:
+            self._worker._streams.pop(self._task_id, None)
+        except Exception:
+            pass

@@ -52,6 +52,8 @@ from ray_tpu._private.memory_store import MemoryStore
 from ray_tpu._private.object_ref import ObjectRef, SerializationContext
 from ray_tpu._private.object_store import PlasmaClient
 from ray_tpu._private.reference_count import ReferenceCounter
+from ray_tpu._private.streaming import (STREAMING, ObjectRefGenerator,
+                                        StreamState)
 from ray_tpu._private.rpc import (ConnectionLost, EventLoopThread, RpcClient,
                                   RpcError, RpcHost, RpcServer, SyncRpcClient)
 from ray_tpu._private.task_spec import (ACTOR_CREATION_TASK, ACTOR_TASK,
@@ -265,6 +267,9 @@ class CoreWorker(RpcHost):
         self._task_events: List[Dict[str, Any]] = []
         self._task_events_lock = threading.Lock()
         self._io.spawn(self._observability_loop())
+        # streaming generator tasks we own: task_id -> StreamState
+        # (reference: _raylet.pyx ObjectRefGenerator machinery)
+        self._streams: Dict[str, StreamState] = {}
         # worker-mode execution state
         self._task_queue: "queue.Queue" = queue.Queue()
         self._actor_instance: Any = None
@@ -345,9 +350,39 @@ class CoreWorker(RpcHost):
         addr = (addr[0], addr[1])
         c = self._worker_clients.get(addr)
         if c is None or c.dead:
-            c = RpcClient(addr[0], addr[1], label=f"worker-{addr[1]}")
+            c = RpcClient(addr[0], addr[1], label=f"worker-{addr[1]}",
+                          on_push=self._on_exec_worker_push)
             self._worker_clients[addr] = c
         return c
+
+    def _on_exec_worker_push(self, method: str, payload: Dict[str, Any]):
+        """Oneway pushes from a worker executing our task (IO loop).
+
+        "stream_item": one yielded value of a streaming generator task
+        (reference: core_worker.proto ReportGeneratorItemReturns).  The
+        item lands exactly like a completed return value — inline bytes
+        in the memory store or a recorded plasma location — so the
+        consumer-facing ObjectRef resolves through the normal get path.
+        """
+        if method != "stream_item":
+            return
+        tid = payload["task_id"]
+        s = self._streams.get(tid)
+        if s is None:
+            return  # generator abandoned; drop late items
+        idx = payload["index"]
+        oid = ObjectID.from_index(TaskID.from_hex(tid), idx + 1).hex()
+        item = payload["item"]
+        if "v" in item:
+            self.memory.set_raw(oid, item["v"])
+        elif "stored" in item:
+            node = tuple(item["stored"]["node"])
+            self._locations[oid] = node
+            self.memory.set_in_plasma(oid, node)
+        else:
+            return  # malformed item
+        s.arrived = max(s.arrived, idx + 1)
+        s.wake()
 
     async def _aclient_agent(self, addr: Tuple[str, int]) -> RpcClient:
         addr = (addr[0], addr[1])
@@ -554,9 +589,12 @@ class CoreWorker(RpcHost):
     def _next_put_oid(self) -> str:
         with self._put_lock:
             self._put_counter += 1
-            # put indices live above the current task's return indices
-            # (tasks may declare >99 returns, e.g. random_shuffle blocks)
-            idx = max(100, self._exec.num_returns + 1) + self._put_counter
+            # put indices live in the top half of the 32-bit index space;
+            # return indices (including unbounded streaming-generator
+            # items, which count up from 1) own the bottom half — a fixed
+            # partition, because both counters are unbounded and any
+            # additive offset scheme could collide
+            idx = 0x8000_0000 + self._put_counter
         tid = TaskID.from_hex(self._exec.task_id or
                               TaskID.for_driver(JobID.from_hex(self.job_id)).hex())
         return ObjectID.from_index(tid, idx).hex()
@@ -956,6 +994,8 @@ class CoreWorker(RpcHost):
                     bundle_index: int = -1) -> List[ObjectRef]:
         from ray_tpu._private.runtime_env import merge as _renv_merge
 
+        if num_returns == "streaming":
+            num_returns = STREAMING
         tid = TaskID.for_normal_task(JobID.from_hex(self.job_id))
         wire_args, contained = self._serialize_args(args, kwargs)
         spec = TaskSpec(
@@ -968,7 +1008,13 @@ class CoreWorker(RpcHost):
             placement_group_id=placement_group_id,
             bundle_index=max(bundle_index, 0) if placement_group_id else -1)
         task = _TaskState(spec, contained)
-        refs = []
+        refs: List[Any] = []
+        if num_returns == STREAMING:
+            # yields arrive incrementally; no automatic retries (a
+            # consumed prefix cannot be replayed) — see streaming.py
+            task.retries_left = 0
+            self._streams[spec.task_id] = StreamState()
+            refs.append(ObjectRefGenerator(self, spec.task_id))
         for oid in task.return_oids:
             self.memory.ensure(oid)
             refs.append(ObjectRef(oid, owner_addr=self.address))
@@ -1014,6 +1060,11 @@ class CoreWorker(RpcHost):
     def _fail_task(self, task: _TaskState, error: BaseException):
         for oid in task.return_oids:
             self.memory.set_error(oid, error)
+        if task.spec.num_returns == STREAMING:
+            s = self._streams.get(task.spec.task_id)
+            if s is not None and s.error is None:
+                s.error = error
+                s.wake()
         with self._lineage_lock:
             self._reconstructing.discard(task.spec.task_id)
         task.contained_refs = []
@@ -1272,6 +1323,22 @@ class CoreWorker(RpcHost):
 
     async def _process_reply(self, task: _TaskState, reply: Dict[str, Any],
                              worker_addr: Tuple[str, int]):
+        if task.spec.num_returns == STREAMING:
+            # every stream_item push was dispatched before this reply
+            # (same ordered connection), so arrived is final here
+            s = self._streams.get(task.spec.task_id)
+            if s is not None:
+                if reply.get("error"):
+                    results = reply.get("results") or []
+                    try:
+                        s.error = cloudpickle.loads(results[0]["err"])
+                    except Exception:
+                        s.error = RayTaskError(
+                            task.spec.name or "stream",
+                            reply.get("error_str", "<unpicklable error>"))
+                else:
+                    s.total = int(reply.get("stream_len", s.arrived))
+                s.wake()
         results = reply.get("results", [])
         nested_all: Dict[str, List] = reply.get("nested") or {}
         for i, oid in enumerate(task.return_oids):
@@ -1375,7 +1442,9 @@ class CoreWorker(RpcHost):
                      runtime_env: Optional[Dict[str, Any]] = None,
                      scheduling_strategy: Optional[Dict[str, Any]] = None,
                      placement_group_id: str = "",
-                     bundle_index: int = -1) -> str:
+                     bundle_index: int = -1,
+                     method_num_returns: Optional[Dict[str, Any]] = None
+                     ) -> str:
         from ray_tpu._private.runtime_env import merge as _renv_merge
 
         aid = ActorID.of(JobID.from_hex(self.job_id))
@@ -1392,7 +1461,8 @@ class CoreWorker(RpcHost):
             scheduling_strategy=scheduling_strategy or {},
             placement_group_id=placement_group_id,
             bundle_index=max(bundle_index, 0) if placement_group_id else -1)
-        self.head.call("create_actor", spec=spec.to_wire(), name=name)
+        self.head.call("create_actor", spec=spec.to_wire(), name=name,
+                       method_num_returns=method_num_returns or {})
         # hold arg refs until the actor is alive; the head owns creation
         astate = _ActorState(aid.hex())
         self._actors[aid.hex()] = astate
@@ -1403,6 +1473,8 @@ class CoreWorker(RpcHost):
     def submit_actor_task(self, actor_id: str, method_name: str, args: tuple,
                           kwargs: dict, num_returns: int = 1,
                           max_retries: int = 0) -> List[ObjectRef]:
+        if num_returns == "streaming":
+            num_returns = STREAMING
         astate = self._actors.get(actor_id)
         if astate is None:
             astate = self._actors.setdefault(actor_id, _ActorState(actor_id))
@@ -1415,7 +1487,11 @@ class CoreWorker(RpcHost):
             method_name=method_name, caller_id=self.worker_id,
             owner_addr=self.address)
         task = _TaskState(spec, contained)
-        refs = []
+        refs: List[Any] = []
+        if num_returns == STREAMING:
+            task.retries_left = 0
+            self._streams[spec.task_id] = StreamState()
+            refs.append(ObjectRefGenerator(self, spec.task_id))
         for oid in task.return_oids:
             self.memory.ensure(oid)
             refs.append(ObjectRef(oid, owner_addr=self.address))
@@ -1586,7 +1662,8 @@ class CoreWorker(RpcHost):
     # ------------------------------------------------------- task execution
 
     async def rpc_push_task(self, spec: Dict[str, Any], instance: int = 0,
-                            tpu_chips: Optional[List[int]] = None):
+                            tpu_chips: Optional[List[int]] = None,
+                            _conn=None):
         """Execute a pushed task (worker mode). Runs user code on the exec
         thread; this handler awaits completion and carries the results back
         in the reply (reference: core_worker.proto PushTask)."""
@@ -1607,7 +1684,7 @@ class CoreWorker(RpcHost):
             # intact for the actor's lifetime.
             os.environ.pop("TPU_VISIBLE_CHIPS", None)
         fut = self._loop().create_future()
-        self._task_queue.put((spec, fut))
+        self._task_queue.put((spec, fut, _conn))
         return await fut
 
     async def rpc_exit_worker(self):
@@ -1623,8 +1700,8 @@ class CoreWorker(RpcHost):
                 for _ in self._exec_threads:
                     self._task_queue.put(None)
                 break
-            spec_wire, fut = item
-            reply = self._execute(spec_wire)
+            spec_wire, fut, conn = item
+            reply = self._execute(spec_wire, conn)
             self._loop().call_soon_threadsafe(
                 lambda f=fut, r=reply: (not f.done()) and f.set_result(r))
 
@@ -1654,7 +1731,8 @@ class CoreWorker(RpcHost):
             }
         return cls._metrics
 
-    def _execute(self, spec_wire: Dict[str, Any]) -> Dict[str, Any]:
+    def _execute(self, spec_wire: Dict[str, Any],
+                 conn=None) -> Dict[str, Any]:
         spec = TaskSpec.from_wire(spec_wire)
         self._exec.task_id = spec.task_id
         self._exec.job_id = spec.job_id
@@ -1693,6 +1771,16 @@ class CoreWorker(RpcHost):
             else:
                 fn = self.functions.fetch(spec.function_id)
                 value = fn(*args, **kwargs)
+            if spec.num_returns == STREAMING:
+                reply = self._stream_out(spec, value, conn)
+                failed = bool(reply.get("error"))
+                m["failed" if failed else "finished"].inc()
+                m["duration"].observe(time.time() - t0)
+                self.record_task_event(
+                    spec.task_id, "FAILED" if failed else "FINISHED",
+                    **({"error": reply.get("error_str", "")[:200]}
+                       if failed else {}))
+                return reply
             if inspect.iscoroutine(value):
                 # async def tasks/actor methods (reference: async actors,
                 # _raylet.pyx execute_task coroutine path).  All
@@ -1709,7 +1797,79 @@ class CoreWorker(RpcHost):
         m["finished"].inc()
         m["duration"].observe(time.time() - t0)
         self.record_task_event(spec.task_id, "FINISHED")
-        return self._success_reply(spec, value, arg_ref_oids)
+        try:
+            return self._success_reply(spec, value, arg_ref_oids)
+        except BaseException as e:
+            # an unserializable return value (e.g. a generator returned
+            # without num_returns="streaming") must produce an error
+            # reply, not kill the exec thread and hang the owner's push
+            return self._error_reply(spec, e, traceback.format_exc())
+
+    def _stream_out(self, spec: TaskSpec, value: Any,
+                    conn) -> Dict[str, Any]:
+        """Drive a streaming generator task: report each yield to the
+        owner over the task-push connection as it is produced (reference:
+        _raylet.pyx:1104 execute_streaming_generator_sync/async +
+        ReportGeneratorItemReturns).  Sync and async generators both
+        work; async items are pulled on the shared async-exec loop."""
+        import asyncio as _aio
+
+        if hasattr(value, "__anext__"):
+            agen = value
+
+            def _items():
+                while True:
+                    try:
+                        yield self._run_coroutine(agen.__anext__())
+                    except StopAsyncIteration:
+                        return
+            items = _items()
+        elif hasattr(value, "__next__"):
+            items = value
+        else:
+            return self._error_reply(spec, TypeError(
+                "num_returns='streaming' requires the task body to be a "
+                f"generator (got {type(value).__name__})"), "")
+        tid = TaskID.from_hex(spec.task_id)
+        loop = self._loop()
+        n = 0
+        try:
+            for item in items:
+                oid = ObjectID.from_index(tid, n + 1).hex()
+                with SerializationContext() as ctx:
+                    frames, size = serialization.serialize(item)
+                if ctx.refs:
+                    # items containing ObjectRefs would need the
+                    # nested-ref ack/pin protocol per item; unsupported —
+                    # fail loudly instead of letting the inner objects be
+                    # released while the consumer still holds the refs
+                    raise TypeError(
+                        "streamed items must not contain ObjectRefs; "
+                        "yield values, not references")
+                if size <= config.max_direct_call_object_size:
+                    blob = bytearray(size)
+                    serialization.pack_into(frames, memoryview(blob))
+                    wire = {"v": bytes(blob)}
+                else:
+                    self.plasma.put_serialized(oid, frames, size,
+                                               primary=True)
+                    wire = {"stored": {"oid": oid,
+                                       "node": list(self.agent_addr)}}
+                if conn is not None:
+                    # ordered: call_soon_threadsafe enqueues FIFO and each
+                    # push writes its frame in the coroutine's first step,
+                    # so items and the final reply arrive in order
+                    loop.call_soon_threadsafe(
+                        _aio.ensure_future,
+                        conn.push("stream_item", {
+                            "task_id": spec.task_id, "index": n,
+                            "item": wire}))
+                n += 1
+        except BaseException as e:
+            reply = self._error_reply(spec, e, traceback.format_exc())
+            reply["stream_len"] = n  # items before the break stay valid
+            return reply
+        return {"results": [], "stream_len": n}
 
     _async_exec_loop = None
     _async_exec_lock = threading.Lock()

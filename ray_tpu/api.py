@@ -184,7 +184,8 @@ def get_actor(name: str) -> "ActorHandle":
     reply = w.head.call("get_named_actor", name=name)
     if not reply.get("found"):
         raise ValueError(f"no actor named {name!r}")
-    return ActorHandle(reply["actor_id"])
+    return ActorHandle(reply["actor_id"],
+                       method_num_returns=reply.get("method_num_returns"))
 
 
 def cluster_resources() -> Dict[str, float]:
@@ -251,7 +252,7 @@ class RemoteFunction:
             scheduling_strategy=_strategy_wire(self._opts),
             placement_group_id=pg.id if pg is not None else "",
             bundle_index=self._opts.get("placement_group_bundle_index", -1))
-        if self._num_returns == 1:
+        if self._num_returns == 1 or self._num_returns == "streaming":
             return refs[0]
         return refs
 
@@ -318,17 +319,10 @@ class ActorMethod:
         self._name = name
 
     def remote(self, *args, **kwargs):
-        h = self._handle
-        w = _worker()
-        num_returns = h._method_num_returns.get(self._name, 1)
-        refs = w.submit_actor_task(
-            h._actor_id, self._name, args, kwargs, num_returns=num_returns,
-            max_retries=h._max_task_retries)
-        if num_returns == 1:
-            return refs[0]
-        return refs
+        num_returns = self._handle._method_num_returns.get(self._name, 1)
+        return self._remote_n(num_returns, *args, **kwargs)
 
-    def options(self, *, num_returns: int = 1):
+    def options(self, *, num_returns: Union[int, str] = 1):
         m = ActorMethod(self._handle, self._name)
         m.remote = lambda *a, **kw: self._remote_n(num_returns, *a, **kw)
         return m
@@ -339,7 +333,7 @@ class ActorMethod:
             self._handle._actor_id, self._name, args, kwargs,
             num_returns=num_returns,
             max_retries=self._handle._max_task_retries)
-        return refs[0] if num_returns == 1 else refs
+        return refs[0] if num_returns in (1, "streaming") else refs
 
     def __call__(self, *a, **kw):
         raise TypeError(f"Actor method {self._name} must be called with .remote()")
@@ -429,10 +423,23 @@ class ActorClass:
             runtime_env=_normalized_renv(self, w),
             scheduling_strategy=_strategy_wire(self._opts),
             placement_group_id=pg.id if pg is not None else "",
-            bundle_index=self._opts.get("placement_group_bundle_index", -1))
+            bundle_index=self._opts.get("placement_group_bundle_index", -1),
+            method_num_returns=self._method_num_returns())
         owner = self._lifetime != "detached"
         return ActorHandle(actor_id, max_task_retries=self._max_task_retries,
+                           method_num_returns=self._method_num_returns(),
                            _owner=owner)
+
+    def _method_num_returns(self) -> Dict[str, Any]:
+        """Collect @method(num_returns=...) annotations off the class
+        (reference: python/ray/actor.py method decorator)."""
+        out: Dict[str, Any] = {}
+        for name in dir(self._cls):
+            fn = getattr(self._cls, name, None)
+            nr = getattr(fn, "__rt_num_returns__", None)
+            if nr is not None:
+                out[name] = nr
+        return out
 
     def bind(self, *args, **kwargs):
         """Build an actor DAG node instead of creating the actor now
@@ -446,6 +453,21 @@ class ActorClass:
 
 
 # ------------------------------------------------------------------- remote
+
+
+def method(*, num_returns: Union[int, str] = 1):
+    """Annotate an actor method's return shape, e.g. streaming:
+
+        @ray_tpu.remote
+        class A:
+            @ray_tpu.method(num_returns="streaming")
+            def gen(self): yield ...
+
+    (reference: python/ray/actor.py:42 @ray.method)."""
+    def mark(fn):
+        fn.__rt_num_returns__ = num_returns
+        return fn
+    return mark
 
 
 def remote(*args, **kwargs):

@@ -368,6 +368,11 @@ class Dataset:
                         rr += 1
                     yield tally(ray_tpu.get(in_flight.pop(0), timeout=600))
                 return
+            if self._ops and len(pending) >= 4:
+                # enough work to amortize shard tasks: the generator-based
+                # executor replaces per-block task submission
+                yield from self._iter_blocks_stream_shards(pending, tally)
+                return
             while pending or in_flight:
                 while pending and len(in_flight) < _stream_window():
                     in_flight.append(self._submit_block(pending.pop(0)))
@@ -375,6 +380,59 @@ class Dataset:
                 yield tally(ray_tpu.get(ref, timeout=600))
         finally:
             finish()
+
+    def _iter_blocks_stream_shards(self, refs: List[Any], tally):
+        """Task-path executor rebuilt on streaming generators: k shard
+        tasks each pull their source blocks and YIELD each transformed
+        block as it is produced, so consumption overlaps production
+        without a driver-side in-flight window (reference: the streaming
+        executor consuming generator outputs —
+        data/_internal/execution/streaming_executor.py + the
+        generator-backed MapOperator).  Streaming tasks are not
+        auto-retried; a shard that dies mid-stream is resubmitted here
+        for only its unconsumed suffix."""
+        import ray_tpu
+
+        import ray_tpu
+
+        k = min(4, len(refs))
+        size = (len(refs) + k - 1) // k
+        chunks = [refs[i * size:(i + 1) * size] for i in builtins.range(k)]
+        chunks = [c for c in chunks if c]
+        fn = _remote_fused_stream()
+        # at most 2 shards producing ahead of consumption: unconsumed
+        # yields buffer owner-side, so eager-launching every shard would
+        # materialize most of the dataset before it is iterated
+        gens: List[Any] = [None] * len(chunks)
+        def launch(i):
+            if i < len(chunks) and gens[i] is None:
+                gens[i] = fn.remote(chunks[i], self._ops)
+        launch(0)
+        launch(1)
+        for ci, chunk in enumerate(chunks):
+            consumed = 0
+            attempts = 3
+            gen = gens[ci]
+            while consumed < len(chunk):
+                try:
+                    ref = gen.next_ref(timeout=600)
+                except StopIteration:
+                    raise RuntimeError(
+                        f"shard stream ended after {consumed}/{len(chunk)} "
+                        "blocks — op chain yielded short")
+                except ray_tpu.RayWorkerError:
+                    # worker died mid-stream: streaming tasks don't
+                    # auto-retry, so resubmit the unconsumed suffix
+                    attempts -= 1
+                    if attempts <= 0:
+                        raise
+                    gen = fn.remote(chunk[consumed:], self._ops)
+                    continue
+                # deterministic op errors (RayTaskError) propagate —
+                # re-running the chain would just fail again
+                yield tally(ray_tpu.get(ref, timeout=600))
+                consumed += 1
+            launch(ci + 2)
 
     def iter_rows(self) -> Iterator[dict]:
         for block in self.iter_blocks():
@@ -543,6 +601,25 @@ def _remote_fused():
         import ray_tpu
 
         fn = _remote_cache["fused"] = ray_tpu.remote(_fused_block_task)
+    return fn
+
+
+def _fused_stream_task(refs, ops):
+    """Shard executor body: fetch each source block, run the fused op
+    chain, and yield the result — one streamed item per block."""
+    import ray_tpu
+
+    for r in refs:
+        yield _apply_ops(ray_tpu.get(r), ops)
+
+
+def _remote_fused_stream():
+    fn = _remote_cache.get("fused_stream")
+    if fn is None:
+        import ray_tpu
+
+        fn = _remote_cache["fused_stream"] = ray_tpu.remote(
+            num_returns="streaming")(_fused_stream_task)
     return fn
 
 

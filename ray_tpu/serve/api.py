@@ -224,6 +224,21 @@ class _Replica:
             return self._callable(*args, **kwargs)
         return getattr(self._callable, method)(*args, **kwargs)
 
+    def stream_request(self, method: str, args, kwargs):
+        """Generator variant: the user callable must return/be a
+        generator; each item streams to the caller as its own object
+        (reference: _private/replica.py handle_request_streaming).
+        Invoked with num_returns="streaming" by DeploymentHandle.stream."""
+        out = self.handle_request(method, args, kwargs)
+        if not hasattr(out, "__next__") and not hasattr(out, "__anext__"):
+            raise TypeError(
+                f"stream() requires {method!r} to return a generator; "
+                f"got {type(out).__name__}")
+        if hasattr(out, "__anext__"):
+            raise TypeError("async generators are not supported through "
+                            "serve stream(); use a sync generator")
+        yield from out
+
     def health(self):
         return True
 
@@ -849,6 +864,40 @@ class DeploymentHandle:
 
         _shared_waiter.watch(ref, _done_cb)
         return ref
+
+    def stream(self, *args, _method: str = "__call__", **kwargs):
+        """Call a generator endpoint; yields one ObjectRef per item as
+        the replica produces them (reference: DeploymentResponseGenerator
+        in serve/handle.py).  Token streaming for TPU inference rides
+        this: the replica yields tokens, callers consume mid-generation."""
+        import random
+
+        self._maybe_refresh()
+        if not self._replicas:
+            self._maybe_refresh(force=True)
+        with self._lock:
+            if not self._replicas:
+                raise RuntimeError(
+                    f"deployment {self._name!r} has no replicas")
+            pool = self._replicas
+            if len(pool) > 2:
+                pool = random.sample(pool, 2)
+            replica = min(pool,
+                          key=lambda r: self._inflight.get(r._actor_id, 0))
+            rid = replica._actor_id
+            self._inflight[rid] = self._inflight.get(rid, 0) + 1
+        gen = replica.stream_request.options(
+            num_returns="streaming").remote(_method, args, kwargs)
+
+        def _wrapped():
+            try:
+                yield from gen
+            finally:
+                with self._lock:
+                    if rid in self._inflight:
+                        self._inflight[rid] -= 1
+
+        return _wrapped()
 
     def method(self, name: str):
         def call(*args, **kwargs):

@@ -90,9 +90,12 @@ class _HttpProxy:
                 length = int(headers.get("content-length", 0) or 0)
                 if length:
                     body = await reader.readexactly(length)
-                status, payload = await self._route(method, target,
-                                                    headers, body)
+                status, payload, stream = await self._route(method, target,
+                                                            headers, body)
                 keep = headers.get("connection", "keep-alive") != "close"
+                if stream is not None:
+                    await self._write_chunked(writer, stream)
+                    break  # chunked responses close the connection
                 writer.write(
                     b"HTTP/1.1 " + status.encode() + b"\r\n"
                     b"Content-Type: application/json\r\n"
@@ -123,39 +126,112 @@ class _HttpProxy:
         parts = urlsplit(target)
         path = parts.path.strip("/")
         if path == "-/healthz":
-            return "200 OK", b'"ok"'
+            return "200 OK", b'"ok"', None
         if not path or "/" in path:
             return "404 Not Found", json.dumps(
-                {"error": f"no route {parts.path!r}"}).encode()
+                {"error": f"no route {parts.path!r}"}).encode(), None
         if method == "GET":
             arg: Any = dict(parse_qsl(parts.query))
         elif headers.get("content-type", "").startswith("application/json"):
             try:
                 arg = json.loads(body or b"null")
             except ValueError:
-                return "400 Bad Request", b'{"error": "invalid json"}'
+                return "400 Bad Request", b'{"error": "invalid json"}', None
         else:
             arg = body
+        # streaming negotiation (reference: serve streaming responses via
+        # StreamingResponse): Accept: text/event-stream opts the request
+        # into a chunked response fed by the replica's generator
+        want_stream = headers.get("accept", "").startswith(
+            "text/event-stream")
         loop = asyncio.get_running_loop()
         try:
+            if want_stream:
+                gen = await loop.run_in_executor(
+                    None, self._stream_blocking, path, arg)
+                return "200 OK", b"", gen
             result = await loop.run_in_executor(
                 None, self._call_blocking, path, arg)
         except KeyError:
             return "404 Not Found", json.dumps(
-                {"error": f"no deployment named {path!r}"}).encode()
+                {"error": f"no deployment named {path!r}"}).encode(), None
         except Exception as e:
             return "500 Internal Server Error", json.dumps(
-                {"error": f"{type(e).__name__}: {e}"}).encode()
+                {"error": f"{type(e).__name__}: {e}"}).encode(), None
         try:
             payload = json.dumps(result).encode()
         except TypeError:
             payload = json.dumps(str(result)).encode()
-        return "200 OK", payload
+        return "200 OK", payload, None
 
-    def _call_blocking(self, name: str, arg: Any):
+    async def _write_chunked(self, writer, gen):
+        """Write one HTTP/1.1 chunk per streamed item (JSON + newline),
+        pulling items off the blocking generator in the executor."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"Content-Type: text/event-stream\r\n"
+                     b"Transfer-Encoding: chunked\r\n"
+                     b"Connection: close\r\n\r\n")
+        await writer.drain()
+        _end = object()
+        try:
+            while True:
+                item = await loop.run_in_executor(None, next, gen, _end)
+                if item is _end:
+                    break
+                try:
+                    data = json.dumps(item).encode() + b"\n"
+                except TypeError:
+                    data = json.dumps(str(item)).encode() + b"\n"
+                writer.write(hex(len(data))[2:].encode() + b"\r\n"
+                             + data + b"\r\n")
+                await writer.drain()
+        except Exception as e:
+            data = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
+            writer.write(hex(len(data))[2:].encode() + b"\r\n"
+                         + data + b"\r\n")
+        writer.write(b"0\r\n\r\n")
+        await writer.drain()
+
+    def _stream_blocking(self, name: str, arg: Any):
+        """Resolve the handle and return an iterator of ITEM VALUES
+        (refs resolved here, off the event loop).  Like _call_blocking,
+        a stale cached handle (replicas replaced wholesale) refreshes
+        once — safe to restart the stream only before any item was
+        consumed."""
         import ray_tpu
+
+        handle = self._resolve_handle(name)
+
+        def _values():
+            nonlocal handle
+            gen = handle.stream(arg)
+            yielded = retried = False
+            while True:
+                try:
+                    ref = next(gen, None)
+                    if ref is None:
+                        return
+                    value = ray_tpu.get(ref, timeout=120)
+                except ray_tpu.RayError:
+                    if yielded or retried:
+                        raise  # mid-stream death: cannot transparently restart
+                    retried = True
+                    handle = self._resolve_handle(name, fresh=True)
+                    gen = handle.stream(arg)
+                    continue
+                yielded = True
+                yield value
+
+        return _values()
+
+    def _resolve_handle(self, name: str, fresh: bool = False):
         from ray_tpu.serve import api as serve_api
 
+        if fresh:
+            self._handles.pop(name, None)
         handle = self._handles.get(name)
         if handle is None:
             try:
@@ -163,13 +239,17 @@ class _HttpProxy:
             except ValueError:
                 raise KeyError(name)
             self._handles[name] = handle
+        return handle
+
+    def _call_blocking(self, name: str, arg: Any):
+        import ray_tpu
+
+        handle = self._resolve_handle(name)
         try:
             return ray_tpu.get(handle.remote(arg), timeout=120)
         except ray_tpu.RayError:
             # replicas may have been replaced wholesale: refresh once
-            self._handles.pop(name, None)
-            handle = serve_api.get_handle(name)
-            self._handles[name] = handle
+            handle = self._resolve_handle(name, fresh=True)
             return ray_tpu.get(handle.remote(arg), timeout=120)
 
 

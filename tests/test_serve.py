@@ -304,3 +304,59 @@ def test_rpc_ingress(cluster):
         client.close()
         serve.stop_rpc_ingress()
         serve.delete("scorer")
+
+
+def test_handle_streaming(cluster):
+    """A generator deployment streams items through handle.stream()
+    before the replica call completes (reference: serve streaming
+    responses / DeploymentResponseGenerator)."""
+    @serve.deployment(name="tokens")
+    class Tokens:
+        def __call__(self, n):
+            import time as t
+            for i in range(int(n)):
+                yield {"tok": i, "ts": t.time()}
+                t.sleep(0.1)
+
+    handle = serve.run(Tokens.bind())
+    t0 = time.time()
+    items = []
+    first_lag = None
+    for ref in handle.stream(5):
+        v = ray_tpu.get(ref, timeout=30)
+        if first_lag is None:
+            first_lag = time.time() - v["ts"]
+        items.append(v["tok"])
+    assert items == [0, 1, 2, 3, 4]
+    # first token consumable well before the full 0.5s of generation
+    assert first_lag < 0.3, f"first token lagged {first_lag:.2f}s"
+    serve.delete("tokens")
+
+
+def test_http_streaming_chunked(cluster):
+    """Accept: text/event-stream gets a chunked response fed by the
+    replica's generator, tokens arriving progressively (reference:
+    serve StreamingResponse over HTTP)."""
+    import http.client
+
+    @serve.deployment(name="sse")
+    def sse(q):
+        for i in range(4):
+            yield f"tok{i}"
+
+    serve.run(sse.bind())
+    host, port = serve.start_http()
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn.request("GET", "/sse", headers={"Accept": "text/event-stream"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Transfer-Encoding") == "chunked"
+        body = resp.read().decode()
+        lines = [l for l in body.splitlines() if l.strip()]
+        import json as _json
+        assert [_json.loads(l) for l in lines] == [f"tok{i}" for i in range(4)]
+        conn.close()
+    finally:
+        serve.shutdown_http()
+        serve.delete("sse")

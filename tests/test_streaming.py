@@ -1,0 +1,192 @@
+"""Streaming generator tests (num_returns="streaming").
+
+Mirrors the reference's streaming generator behavior
+(reference: python/ray/tests/test_streaming_generator.py;
+machinery at python/ray/_raylet.pyx:272,1104): yields are consumable
+BEFORE the task finishes, large items ride plasma, mid-stream errors
+surface at the break position, and actor methods (sync + async) stream.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=96 * 1024 * 1024)
+    try:
+        yield ray_tpu
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_items_stream_before_completion(cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        import time as t
+        for i in range(5):
+            yield (i, t.time())
+            t.sleep(0.15)
+
+    g = gen.remote()
+    assert isinstance(g, ray_tpu.ObjectRefGenerator)
+    # the first item must arrive while the task is still sleeping
+    # through later yields — i.e. before ~0.6s of remaining run time
+    i0, produced = ray_tpu.get(g.next_ref(timeout=30))
+    lag = time.time() - produced
+    assert i0 == 0
+    assert lag < 0.5, f"first yield arrived {lag:.2f}s after production"
+    assert not g.completed()
+    rest = [ray_tpu.get(r, timeout=30)[0] for r in g]
+    assert rest == [1, 2, 3, 4]
+    assert g.completed()
+
+
+def test_large_items_via_plasma(cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def big():
+        for i in range(3):
+            yield np.full(200_000, i, dtype=np.float64)  # 1.6 MB each
+
+    vals = [ray_tpu.get(r, timeout=60) for r in big.remote()]
+    assert [int(v[0]) for v in vals] == [0, 1, 2]
+    assert all(len(v) == 200_000 for v in vals)
+
+
+def test_midstream_error_preserves_prefix(cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def bad():
+        yield "a"
+        yield "b"
+        raise ValueError("boom")
+
+    g = bad.remote()
+    assert ray_tpu.get(next(g), timeout=30) == "a"
+    assert ray_tpu.get(next(g), timeout=30) == "b"
+    with pytest.raises(ray_tpu.RayTaskError):
+        next(g)
+
+
+def test_non_generator_body_errors(cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def notgen():
+        return 42
+
+    with pytest.raises(ray_tpu.RayTaskError):
+        next(notgen.remote())
+
+
+def test_actor_method_streaming(cluster):
+    @ray_tpu.remote
+    class Gen:
+        @ray_tpu.method(num_returns="streaming")
+        def tokens(self, n):
+            for i in range(n):
+                yield f"tok{i}"
+
+        def plain(self):
+            return "ok"
+
+    a = Gen.remote()
+    toks = [ray_tpu.get(r, timeout=30) for r in a.tokens.remote(5)]
+    assert toks == [f"tok{i}" for i in range(5)]
+    # non-annotated methods unaffected
+    assert ray_tpu.get(a.plain.remote(), timeout=30) == "ok"
+    # .options() override works too
+    toks = [ray_tpu.get(r, timeout=30)
+            for r in a.tokens.options(num_returns="streaming").remote(2)]
+    assert toks == ["tok0", "tok1"]
+
+
+def test_async_generator_streaming(cluster):
+    @ray_tpu.remote
+    class AGen:
+        @ray_tpu.method(num_returns="streaming")
+        async def aiter(self, n):
+            import asyncio
+            for i in range(n):
+                await asyncio.sleep(0.01)
+                yield i * 2
+
+    a = AGen.remote()  # keep the owning handle alive while streaming
+    vals = [ray_tpu.get(r, timeout=30) for r in a.aiter.remote(4)]
+    assert vals == [0, 2, 4, 6]
+
+
+def test_generator_not_picklable(cluster):
+    import cloudpickle
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        yield 1
+
+    g = gen.remote()
+    with pytest.raises(TypeError):
+        cloudpickle.dumps(g)
+    list(g)  # drain
+
+
+def test_nested_consumption_donates_cpu(cluster):
+    """A task consuming a stream must not deadlock the node: the
+    consumer donates its CPU while blocked in __next__ (same rule as
+    get; reference: HandleWorkerBlocked)."""
+    @ray_tpu.remote(num_returns="streaming")
+    def producer():
+        for i in range(3):
+            yield i
+
+    @ray_tpu.remote(num_cpus=4)  # hog every CPU, then consume
+    def consumer():
+        g = producer.remote()
+        return [ray_tpu.get(r) for r in g]
+
+    assert ray_tpu.get(consumer.remote(), timeout=60) == [0, 1, 2]
+
+
+def test_get_actor_carries_streaming_annotation(cluster):
+    """A handle fetched by name must stream like the creating handle —
+    @method annotations travel through the head's actor table."""
+    import ray_tpu.api as rapi
+
+    class Named:
+        @ray_tpu.method(num_returns="streaming")
+        def gen(self, n):
+            for i in range(n):
+                yield i
+
+    a = rapi.ActorClass(Named, name="named-streamer").remote()
+    assert ray_tpu.get(next(a.gen.remote(1)), timeout=30) == 0
+    h = ray_tpu.get_actor("named-streamer")
+    vals = [ray_tpu.get(r, timeout=30) for r in h.gen.remote(3)]
+    assert vals == [0, 1, 2]
+    ray_tpu.kill(a)
+
+
+def test_put_inside_streaming_task_no_collision(cluster):
+    """put() ObjectIDs and streamed-item ObjectIDs share a task_id but
+    partitioned index spaces — no silent collision (regression)."""
+    @ray_tpu.remote(num_returns="streaming")
+    def gen_with_puts():
+        refs = []
+        for i in range(5):
+            refs.append(ray_tpu.put(i * 100))
+            yield i
+        # resolve the puts at the end: values must be intact
+        assert [ray_tpu.get(r) for r in refs] == [0, 100, 200, 300, 400]
+        yield "done"
+
+    vals = [ray_tpu.get(r, timeout=30) for r in gen_with_puts.remote()]
+    assert vals == [0, 1, 2, 3, 4, "done"]
+
+
+def test_yielding_refs_fails_loudly(cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def yields_ref():
+        yield {"ref": ray_tpu.put([1, 2, 3])}
+
+    with pytest.raises(ray_tpu.RayTaskError):
+        next(yields_ref.remote())
