@@ -10,23 +10,24 @@ from ray_tpu._private.errors import (ActorDiedError, ActorUnavailableError,
                                      GetTimeoutError, ObjectFreedError,
                                      ObjectLostError, RayError, RayTaskError,
                                      RayWorkerError, RuntimeEnvSetupError,
-                                     SchedulingError)
+                                     SchedulingError, TaskCancelledError)
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.streaming import ObjectRefGenerator
 from ray_tpu.api import (ActorClass, ActorHandle, RemoteFunction,
-                         available_resources, cluster_resources, get,
+                         available_resources, cancel, cluster_resources, get,
                          get_actor, init, is_initialized, kill, method, nodes,
                          put, remote, shutdown, wait)
 
 __version__ = "0.2.0"
 
 __all__ = [
-    "init", "shutdown", "remote", "get", "put", "wait", "kill", "get_actor",
-    "method", "cluster_resources", "available_resources", "nodes",
-    "is_initialized", "ObjectRef", "ObjectRefGenerator", "ActorHandle",
-    "ActorClass", "RemoteFunction",
+    "init", "shutdown", "remote", "get", "put", "wait", "kill", "cancel",
+    "get_actor", "method", "cluster_resources", "available_resources",
+    "nodes", "is_initialized", "ObjectRef", "ObjectRefGenerator",
+    "ActorHandle", "ActorClass", "RemoteFunction",
     "RayError", "RayTaskError", "RayWorkerError", "ActorDiedError",
     "ActorUnavailableError", "ObjectLostError", "ObjectFreedError",
     "GetTimeoutError", "SchedulingError", "RuntimeEnvSetupError",
+    "TaskCancelledError",
     "__version__",
 ]

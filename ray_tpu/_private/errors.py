@@ -64,3 +64,8 @@ class SchedulingError(RayError):
 class RuntimeEnvSetupError(RayError):
     """The task's runtime environment could not be prepared on the node
     (reference: python/ray/exceptions.py RuntimeEnvSetupError)."""
+
+
+class TaskCancelledError(RayError):
+    """The task was cancelled via ray_tpu.cancel()
+    (reference: python/ray/exceptions.py TaskCancelledError)."""

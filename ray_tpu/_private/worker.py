@@ -39,10 +39,12 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 import cloudpickle
+from concurrent.futures import CancelledError as _futures_cancelled
 
 from ray_tpu._private import serialization
 from ray_tpu._private.config import config
-from ray_tpu._private.errors import (ActorDiedError, GetTimeoutError,
+from ray_tpu._private.errors import (TaskCancelledError,
+                                     ActorDiedError, GetTimeoutError,
                                      ObjectFreedError, ObjectLostError,
                                      RayTaskError, RayWorkerError,
                                      RuntimeEnvSetupError, SchedulingError)
@@ -270,6 +272,19 @@ class CoreWorker(RpcHost):
         # streaming generator tasks we own: task_id -> StreamState
         # (reference: _raylet.pyx ObjectRefGenerator machinery)
         self._streams: Dict[str, StreamState] = {}
+        # cancellation (reference: core_worker CancelTask):
+        # owner side — task_ids we force-cancelled (their worker death
+        # must surface TaskCancelledError, never a retry)
+        self._cancelled_tasks: Set[str] = set()
+        # executor side — cancel-before-start marks, and live execution
+        # handles so a cancel RPC can interrupt the running body
+        self._cancelled_exec: Set[str] = set()
+        # task_ids accepted by rpc_push_task and not yet finished — a
+        # cancel for anything else is a no-op (keeps the mark set from
+        # accumulating entries for already-finished tasks)
+        self._exec_pending: Set[str] = set()
+        self._sync_running: Dict[str, int] = {}   # task_id -> thread ident
+        self._async_running: Dict[str, Any] = {}  # task_id -> conc. future
         # worker-mode execution state
         self._task_queue: "queue.Queue" = queue.Queue()
         self._actor_instance: Any = None
@@ -1057,6 +1072,72 @@ class CoreWorker(RpcHost):
                 arg.object_id = None
         return True
 
+    # ---------------------------------------------------------- cancellation
+
+    def cancel(self, target, force: bool = False) -> None:
+        """Cancel a task by any of its return refs or its generator
+        (reference: python/ray/_private/worker.py:2942 ray.cancel).
+        No-op if the task already finished."""
+        if isinstance(target, ObjectRefGenerator):
+            task_id = target.task_id
+        else:
+            task_id = ObjectID(bytes.fromhex(target.oid)).task_id().hex()
+        self._io.run(self._cancel_async(task_id, force), timeout=30.0)
+
+    async def _cancel_async(self, task_id: str, force: bool):
+        err = TaskCancelledError(f"task {task_id[:12]} was cancelled")
+        # 1. still pending owner-side (never pushed): fail it locally
+        for state in self._sched.values():
+            for task in list(state.pending):
+                if task.spec.task_id == task_id:
+                    state.pending.remove(task)
+                    self._fail_task(task, err)
+                    return
+            # 2. pushed to a leased worker: interrupt it there
+            for lease in state.leases:
+                for task in list(lease.inflight):
+                    if task.spec.task_id == task_id:
+                        await self._cancel_on_worker(
+                            task, lease.addr, force)
+                        return
+        for astate in self._actors.values():
+            for task in list(astate.pending):
+                if task.spec.task_id == task_id:
+                    astate.pending.remove(task)
+                    self._fail_task(task, err)
+                    return
+            for task in list(astate.inflight.values()):
+                if task.spec.task_id == task_id and astate.addr:
+                    await self._cancel_on_worker(task, astate.addr, force)
+                    return
+        # already finished (or unknown): no-op, like the reference
+
+    def _take_cancelled(self, task: _TaskState) -> bool:
+        """If this task was force-cancelled, consume the mark and resolve
+        it as cancelled.  Used by the connection-failure handlers: the
+        worker's death IS the cancellation outcome, never a retryable
+        fault."""
+        if task.spec.task_id not in self._cancelled_tasks:
+            return False
+        self._cancelled_tasks.discard(task.spec.task_id)
+        self._fail_task(task, TaskCancelledError(
+            f"task {task.spec.task_id[:12]} was cancelled (force=True)"))
+        return True
+
+    async def _cancel_on_worker(self, task: _TaskState,
+                                addr: Tuple[str, int], force: bool):
+        task.retries_left = 0
+        if force:
+            # the worker will exit; the push failure must read as
+            # cancellation, not a worker fault to retry
+            self._cancelled_tasks.add(task.spec.task_id)
+        try:
+            c = await self._aclient_worker(addr)
+            await c.call("cancel_task", task_id=task.spec.task_id,
+                         force=force, timeout=10.0)
+        except Exception:
+            pass  # worker already gone: the push path resolves the task
+
     def _fail_task(self, task: _TaskState, error: BaseException):
         for oid in task.return_oids:
             self.memory.set_error(oid, error)
@@ -1259,7 +1340,9 @@ class CoreWorker(RpcHost):
                 lease.inflight.remove(task)
             except ValueError:
                 pass
-            if not started or task.retries_left != 0:
+            if self._take_cancelled(task):
+                pass
+            elif not started or task.retries_left != 0:
                 if started and task.retries_left > 0:
                     task.retries_left -= 1
                 await self._sleep(config.task_retry_delay_ms / 1000.0)
@@ -1369,6 +1452,9 @@ class CoreWorker(RpcHost):
                 if task.spec.kind == NORMAL_TASK:
                     self._record_lineage(task, oid)
                 self.memory.set_in_plasma(oid, node)
+        # the worker replied normally (e.g. a force-cancel caught the task
+        # still queued): the force-death mapping entry is no longer needed
+        self._cancelled_tasks.discard(task.spec.task_id)
         for b_oid in reply.get("borrows") or []:
             self.rc.add_borrower(b_oid, worker_addr)
         if reply.get("needs_ack"):
@@ -1621,7 +1707,9 @@ class CoreWorker(RpcHost):
                              instance: int, error: Exception):
         """Connection to the actor failed mid-call."""
         astate.inflight.pop(task.spec.seqno, None)
-        if task.retries_left != 0:
+        if self._take_cancelled(task):
+            pass
+        elif task.retries_left != 0:
             if task.retries_left > 0:
                 task.retries_left -= 1
             # retryable: requeued, re-sent after re-resolve
@@ -1684,24 +1772,73 @@ class CoreWorker(RpcHost):
             # intact for the actor's lifetime.
             os.environ.pop("TPU_VISIBLE_CHIPS", None)
         fut = self._loop().create_future()
+        self._exec_pending.add(spec.get("tid", ""))
         self._task_queue.put((spec, fut, _conn))
         return await fut
+
+    async def rpc_cancel_task(self, task_id: str, force: bool = False):
+        """Owner requests cancellation of a task pushed to this worker
+        (reference: core_worker.proto CancelTask; _raylet.pyx raises
+        TaskCancelledError in the executing thread).
+
+        Queued-but-unstarted: marked, skipped at dequeue.  Running async
+        body: the asyncio task is cancelled.  Running sync body: a
+        TaskCancelledError is raised in the exec thread at its next
+        bytecode boundary (a body blocked in native code is only
+        interruptible with force).  force=True: the whole worker process
+        exits — the owner observes the connection drop and maps it to
+        TaskCancelledError via its cancelled-task set."""
+        if task_id not in self._exec_pending:
+            return {"ok": False}  # finished or never here: no-op
+        self._cancelled_exec.add(task_id)
+        if force and (task_id in self._sync_running
+                      or task_id in self._async_running):
+            loop = self._loop()
+            loop.call_later(0.05, os._exit, 1)  # let the reply flush
+            return {"ok": True, "killing": True}
+        fut = self._async_running.get(task_id)
+        if fut is not None:
+            fut.cancel()
+            return {"ok": True}
+        ident = self._sync_running.get(task_id)
+        if ident is not None:
+            import ctypes
+
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_long(ident), ctypes.py_object(TaskCancelledError))
+        return {"ok": True}
 
     async def rpc_exit_worker(self):
         self._task_queue.put(None)
 
+    def _finish_exec(self, task_id: str) -> None:
+        self._cancelled_exec.discard(task_id)
+        self._exec_pending.discard(task_id)
+
     def exec_loop(self):
         """Worker main loop: executes tasks until exit (reference:
-        python/ray/_private/workers/default_worker.py main loop)."""
+        python/ray/_private/workers/default_worker.py main loop).
+
+        TaskCancelledError guards: PyThreadState_SetAsyncExc is
+        inherently racy — a cancel aimed at a task that just finished
+        can fire here between tasks.  A stale cancellation must not kill
+        this thread (the worker would silently stop serving pushes)."""
         while True:
-            item = self._task_queue.get()
+            try:
+                item = self._task_queue.get()
+            except TaskCancelledError:
+                continue  # stale async-exc from an already-finished task
             if item is None:
                 # propagate shutdown to any extra concurrency threads
                 for _ in self._exec_threads:
                     self._task_queue.put(None)
                 break
             spec_wire, fut, conn = item
-            reply = self._execute(spec_wire, conn)
+            try:
+                reply = self._execute(spec_wire, conn)
+            except BaseException as e:  # _execute never raises by design
+                reply = self._error_reply(TaskSpec.from_wire(spec_wire), e,
+                                          traceback.format_exc())
             self._loop().call_soon_threadsafe(
                 lambda f=fut, r=reply: (not f.done()) and f.set_result(r))
 
@@ -1748,12 +1885,33 @@ class CoreWorker(RpcHost):
         self.record_task_event(spec.task_id, "RUNNING", name=spec.name
                                or spec.method_name or spec.function_id[:8],
                                kind=spec.kind, job_id=spec.job_id)
+        if spec.task_id in self._cancelled_exec:
+            # cancelled while queued behind earlier tasks: never run it
+            self.record_task_event(spec.task_id, "FAILED", error="cancelled")
+            self._finish_exec(spec.task_id)
+            return self._error_reply(
+                spec, TaskCancelledError(f"task {spec.task_id[:12]} was "
+                                         "cancelled before it started"), "")
+        # registered BEFORE arg materialization so a cancel arriving
+        # during a long remote-arg fetch interrupts it (the async exc
+        # fires at the fetch loop's next bytecode) instead of being lost
+        self._sync_running[spec.task_id] = threading.get_ident()
         try:
             args, kwargs, arg_ref_oids = self._materialize_args(spec)
         except BaseException as e:
             m["failed"].inc()
             self.record_task_event(spec.task_id, "FAILED", error=str(e)[:200])
+            self._sync_running.pop(spec.task_id, None)
+            self._finish_exec(spec.task_id)
             return self._error_reply(spec, e, traceback.format_exc())
+        if spec.task_id in self._cancelled_exec:
+            # cancel landed during materialization, after the first check
+            self._sync_running.pop(spec.task_id, None)
+            self.record_task_event(spec.task_id, "FAILED", error="cancelled")
+            self._finish_exec(spec.task_id)
+            return self._error_reply(
+                spec, TaskCancelledError(f"task {spec.task_id[:12]} was "
+                                         "cancelled before it started"), "")
         try:
             if spec.kind == ACTOR_CREATION_TASK:
                 cls = self.functions.fetch(spec.function_id)
@@ -1794,6 +1952,9 @@ class CoreWorker(RpcHost):
             m["duration"].observe(time.time() - t0)
             self.record_task_event(spec.task_id, "FAILED", error=str(e)[:200])
             return self._error_reply(spec, e, traceback.format_exc())
+        finally:
+            self._sync_running.pop(spec.task_id, None)
+            self._finish_exec(spec.task_id)
         m["finished"].inc()
         m["duration"].observe(time.time() - t0)
         self.record_task_event(spec.task_id, "FINISHED")
@@ -1901,7 +2062,20 @@ class CoreWorker(RpcHost):
             fut = asyncio.run_coroutine_threadsafe(coro, loop)
         finally:
             _exec_ctx.reset(token)
-        return fut.result()
+        # registered for cancellation: cancelling this concurrent future
+        # cancels the wrapped asyncio task (reference: async actor task
+        # cancel via Task.cancel)
+        task_id = self._exec.task_id
+        self._async_running[task_id] = fut
+        if task_id in self._cancelled_exec:
+            # cancel landed between exec registration and here, when the
+            # sync path couldn't reach the coroutine — cancel it now so
+            # it doesn't run on as an orphan
+            fut.cancel()
+        try:
+            return fut.result()
+        finally:
+            self._async_running.pop(task_id, None)
 
     def _materialize_args(self, spec: TaskSpec):
         """Deserialize inline args and batch-fetch ref args, preserving
@@ -1983,6 +2157,18 @@ class CoreWorker(RpcHost):
 
     def _error_reply(self, spec: TaskSpec, exc: BaseException, tb: str) -> Dict[str, Any]:
         name = spec.name or spec.method_name or spec.function_id[:8]
+        # this interpreter build's concurrent.futures.CancelledError is a
+        # DISTINCT class from asyncio.CancelledError (verified; upstream
+        # they alias) — both appear on the async cancel path
+        if isinstance(exc, (TaskCancelledError, asyncio.CancelledError,
+                            _futures_cancelled)):
+            # cancellation is not a task failure: surface the dedicated
+            # type, unwrapped (reference: TaskCancelledError from get)
+            blob = cloudpickle.dumps(TaskCancelledError(
+                str(exc) or f"task {name!r} was cancelled"))
+            n = max(1, spec.num_returns)
+            return {"results": [{"err": blob} for _ in range(n)],
+                    "error": True, "error_str": "task cancelled"}
         try:
             wrapped = RayTaskError(name, tb, cause=exc)
             blob = cloudpickle.dumps(wrapped)

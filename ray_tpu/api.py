@@ -179,6 +179,16 @@ def kill(actor: "ActorHandle", *, no_restart: bool = True):
     _worker().kill_actor(actor._actor_id, no_restart=no_restart)
 
 
+def cancel(ref, *, force: bool = False):
+    """Cancel a submitted task (reference: ray.cancel,
+    python/ray/_private/worker.py:2942).  Accepts any return ref of the
+    task or its ObjectRefGenerator.  Non-force interrupts the running
+    body (async tasks are cancelled; sync bodies get TaskCancelledError
+    at the next bytecode); force=True kills the executing worker.
+    Waiters observe TaskCancelledError.  No-op on finished tasks."""
+    _worker().cancel(ref, force=force)
+
+
 def get_actor(name: str) -> "ActorHandle":
     w = _worker()
     reply = w.head.call("get_named_actor", name=name)

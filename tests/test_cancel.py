@@ -1,0 +1,135 @@
+"""ray_tpu.cancel tests (reference: python/ray/tests/test_cancel.py;
+owner-side path python/ray/_private/worker.py:2942, worker interrupt in
+_raylet.pyx / core_worker CancelTask)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    try:
+        yield ray_tpu
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_cancel_running_loop_task(cluster):
+    """Non-force cancel interrupts a running Python loop."""
+    @ray_tpu.remote
+    def spin():
+        import time as t
+        deadline = t.time() + 60
+        while t.time() < deadline:
+            t.sleep(0.01)
+        return "finished"
+
+    ref = spin.remote()
+    time.sleep(1.0)  # let it start spinning
+    ray_tpu.cancel(ref)
+    t0 = time.time()
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert time.time() - t0 < 10, "cancel should interrupt promptly"
+
+
+def test_cancel_queued_task(cluster):
+    """A task cancelled before it starts never runs."""
+    @ray_tpu.remote(num_cpus=2)
+    def hog():
+        import time as t
+        t.sleep(3)
+        return "hog"
+
+    @ray_tpu.remote(num_cpus=2)
+    def queued():
+        return "ran"
+
+    h = hog.remote()  # occupies both CPUs
+    time.sleep(0.3)
+    q = queued.remote()  # stuck behind the hog
+    ray_tpu.cancel(q)
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(q, timeout=30)
+    assert ray_tpu.get(h, timeout=30) == "hog"  # the hog is untouched
+
+
+def test_cancel_async_actor_call(cluster):
+    """Cancelling an async actor call cancels its coroutine; the actor
+    stays alive and serves later calls."""
+    @ray_tpu.remote
+    class A:
+        async def slow(self):
+            import asyncio
+            await asyncio.sleep(60)
+            return "done"
+
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+    ref = a.slow.remote()
+    time.sleep(0.5)  # in flight, awaiting
+    ray_tpu.cancel(ref)
+    t0 = time.time()
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert time.time() - t0 < 10
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+
+
+def test_force_cancel_kills_blocked_worker(cluster):
+    """force=True terminates a body stuck in native code (uninterruptible
+    without killing the worker)."""
+    @ray_tpu.remote
+    def stuck():
+        import time as t
+        t.sleep(600)  # one long native sleep: async-exc can't interrupt
+
+    ref = stuck.remote()
+    time.sleep(1.0)
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+
+    # the cluster still runs tasks afterwards
+    @ray_tpu.remote
+    def ok():
+        return 1
+
+    assert ray_tpu.get(ok.remote(), timeout=60) == 1
+
+
+def test_cancel_finished_task_is_noop(cluster):
+    @ray_tpu.remote
+    def quick():
+        return 7
+
+    ref = quick.remote()
+    assert ray_tpu.get(ref, timeout=30) == 7
+    ray_tpu.cancel(ref)  # no error
+    assert ray_tpu.get(ref, timeout=30) == 7  # result intact
+
+
+def test_cancel_streaming_generator(cluster):
+    """Cancelling by generator stops the producer; consumed items stay."""
+    @ray_tpu.remote(num_returns="streaming")
+    def slow_gen():
+        import time as t
+        for i in range(100):
+            yield i
+            t.sleep(0.05)
+
+    g = slow_gen.remote()
+    first = ray_tpu.get(g.next_ref(timeout=30))
+    assert first == 0
+    ray_tpu.cancel(g)
+    with pytest.raises((ray_tpu.TaskCancelledError, ray_tpu.RayTaskError,
+                        StopIteration)):
+        for _ in range(200):
+            next(g)
