@@ -82,6 +82,18 @@ class MemoryStore:
         e.node_addr = node_addr
         self._fire(e)
 
+    def fail_pending(self, error: BaseException) -> None:
+        """Resolve every still-pending entry with an error — wakes all
+        blocked waiters (get()s, dependency resolution threads parked on
+        entry events).  Called at shutdown so no executor thread stays
+        blocked on an object that can no longer arrive."""
+        with self._lock:
+            entries = list(self._entries.values())
+        for e in entries:
+            if not e.event.is_set():
+                e.error = error
+                self._fire(e)
+
     def reset(self, oid: str) -> None:
         """Forget a resolution (used when re-executing a task for recovery)."""
         with self._lock:

@@ -66,7 +66,9 @@ MODE_WORKER = "worker"
 
 _TASK_PUSH_TIMEOUT = 7 * 86400.0  # tasks may legitimately run for days
 _LEASE_LINGER_S = 0.2
-_LEASE_PIPELINE_DEPTH = 8  # pushes in flight per leased worker
+_LEASE_PIPELINE_DEPTH = 24  # tasks in flight per leased worker (deep
+# enough that a coalesced pump forms large push_tasks batches; only
+# proven-fast task classes pipeline past depth 1, see _pump)
 _PIPELINE_FAST_TASK_S = 0.02  # only pipeline onto leases this fast
 _MAX_RECONSTRUCTION_ROUNDS = 10  # get() retry rounds across object losses
 _MAX_LEASES_PER_CLASS = 16
@@ -111,7 +113,7 @@ _exec_ctx = contextvars.ContextVar("rt_exec_shadow", default=None)
 
 class _TaskState:
     __slots__ = ("spec", "contained_refs", "retries_left", "sched_key",
-                 "return_oids", "deps_ready")
+                 "return_oids", "deps_ready", "cancelled")
 
     def __init__(self, spec: TaskSpec, contained_refs: List[ObjectRef]):
         self.spec = spec
@@ -119,6 +121,7 @@ class _TaskState:
         self.retries_left = spec.max_retries
         self.sched_key = spec.scheduling_class()
         self.deps_ready = True
+        self.cancelled = False  # ray_tpu.cancel hit it mid-resolution
         self.return_oids = [
             ObjectID.from_index(TaskID.from_hex(spec.task_id), i + 1).hex()
             for i in range(spec.num_returns)
@@ -170,7 +173,7 @@ class _Lease:
 
 class _SchedState:
     __slots__ = ("pending", "leases", "inflight_requests", "svc_s",
-                 "request_agents", "req_counter")
+                 "request_agents", "req_counter", "pump_queued")
 
     def __init__(self):
         self.pending: deque = deque()
@@ -186,12 +189,17 @@ class _SchedState:
         # (reference: CancelWorkerLease in node_manager.proto)
         self.request_agents: Dict[str, Tuple[str, int]] = {}
         self.req_counter = 0
+        # True while a coalesced pump callback is queued on the loop:
+        # rapid-fire submissions accumulate in pending and get assigned
+        # in ONE pump (forming real push_tasks batches) instead of one
+        # pump per submission
+        self.pump_queued = False
 
 
 class _ActorState:
     __slots__ = ("actor_id", "addr", "instance", "pending", "inflight",
                  "pumping", "recovering", "dead", "death_cause", "seq",
-                 "resolving")
+                 "resolving", "pump_queued")
 
     def __init__(self, actor_id: str):
         self.actor_id = actor_id
@@ -205,6 +213,7 @@ class _ActorState:
         self.death_cause = ""
         self.seq = 0
         self.resolving = None  # in-flight resolve future (coalesced)
+        self.pump_queued = False  # coalesced-pump callback scheduled
 
 
 class CoreWorker(RpcHost):
@@ -272,6 +281,14 @@ class CoreWorker(RpcHost):
         # streaming generator tasks we own: task_id -> StreamState
         # (reference: _raylet.pyx ObjectRefGenerator machinery)
         self._streams: Dict[str, StreamState] = {}
+        # in-flight batched pushes awaiting per-task "batch_result"
+        # pushes: task_id -> completion context (loop-confined; popped
+        # synchronously in the push handler so the batch's failure path
+        # can tell processed from unprocessed tasks)
+        self._batch_pending: Dict[str, tuple] = {}
+        # normal tasks whose ref args are still resolving (not yet in any
+        # pending queue) — cancellable through here
+        self._resolving_tasks: Dict[str, _TaskState] = {}
         # cancellation (reference: core_worker CancelTask):
         # owner side — task_ids we force-cancelled (their worker death
         # must surface TaskCancelledError, never a retry)
@@ -285,6 +302,9 @@ class CoreWorker(RpcHost):
         self._exec_pending: Set[str] = set()
         self._sync_running: Dict[str, int] = {}   # task_id -> thread ident
         self._async_running: Dict[str, Any] = {}  # task_id -> conc. future
+        # executor-side coalescing buffer for batched-push results:
+        # id(conn) -> (conn, [result items]) flushed once per loop tick
+        self._result_bufs: Dict[int, Tuple[Any, List[Dict[str, Any]]]] = {}
         # worker-mode execution state
         self._task_queue: "queue.Queue" = queue.Queue()
         self._actor_instance: Any = None
@@ -379,6 +399,19 @@ class CoreWorker(RpcHost):
         in the memory store or a recorded plasma location — so the
         consumer-facing ObjectRef resolves through the normal get path.
         """
+        if method == "batch_results":
+            # pop registrations synchronously (the batch failure path
+            # relies on it), then process the whole frame in ONE
+            # coroutine — a Task per result would dominate small-task
+            # throughput
+            work = []
+            for item in payload.get("items") or []:
+                entry = self._batch_pending.pop(item.get("tid", ""), None)
+                if entry is not None:
+                    work.append((entry, item.get("reply")))
+            if work:
+                asyncio.ensure_future(self._finish_batch_items(work))
+            return
         if method != "stream_item":
             return
         tid = payload["task_id"]
@@ -442,6 +475,13 @@ class CoreWorker(RpcHost):
             except Exception:
                 pass
         self._shutdown = True
+        # wake every blocked waiter (gets, dep-resolution executor
+        # threads): their objects can no longer arrive, and a thread
+        # parked on an entry event would hang interpreter exit
+        try:
+            self.memory.fail_pending(RayWorkerError("ray_tpu.shutdown()"))
+        except Exception:
+            pass
         try:
             self.plasma.close()
         except Exception:
@@ -1037,13 +1077,41 @@ class CoreWorker(RpcHost):
             spec.task_id, "SUBMITTED",
             name=name or function_id[:8], kind=NORMAL_TASK,
             job_id=self.job_id)
-        self._spawn(self._submit(task))
+        if any(a.object_id is not None for a in spec.args):
+            self._spawn(self._submit(task))
+        else:
+            # no ref args: nothing to resolve — skip the coroutine
+            # machinery (run_coroutine_threadsafe allocates a Task per
+            # call; call_soon_threadsafe is ~5x cheaper on the hot path)
+            try:
+                self._loop().call_soon_threadsafe(self._enqueue_ready, task)
+            except RuntimeError:
+                pass  # loop shut down
         return refs
+
+    def _enqueue_ready(self, task: _TaskState) -> None:
+        state = self._sched.setdefault(task.sched_key, _SchedState())
+        state.pending.append(task)
+        if not state.pump_queued:
+            # coalesce: every _enqueue_ready already queued on the loop
+            # runs (appending) before this callback pumps them together
+            state.pump_queued = True
+            self._loop().call_soon(self._coalesced_pump, state)
+
+    def _coalesced_pump(self, state: _SchedState) -> None:
+        state.pump_queued = False
+        self._pump(state)
 
     async def _submit(self, task: _TaskState):
         # owner-side dependency resolution (reference: dependency_resolver.h)
-        ok = await self._resolve_deps(task)
-        if not ok:
+        # — registered so ray_tpu.cancel can reach a task whose args are
+        # still resolving (it is in no pending queue yet)
+        self._resolving_tasks[task.spec.task_id] = task
+        try:
+            ok = await self._resolve_deps(task)
+        finally:
+            self._resolving_tasks.pop(task.spec.task_id, None)
+        if not ok or task.cancelled:
             return
         state = self._sched.setdefault(task.sched_key, _SchedState())
         state.pending.append(task)
@@ -1086,6 +1154,13 @@ class CoreWorker(RpcHost):
 
     async def _cancel_async(self, task_id: str, force: bool):
         err = TaskCancelledError(f"task {task_id[:12]} was cancelled")
+        # 0. args still resolving (not yet in any queue): fail it now and
+        # tell _submit to drop it when resolution finishes
+        task = self._resolving_tasks.get(task_id)
+        if task is not None:
+            task.cancelled = True
+            self._fail_task(task, err)
+            return
         # 1. still pending owner-side (never pushed): fail it locally
         for state in self._sched.values():
             for task in list(state.pending):
@@ -1158,12 +1233,26 @@ class CoreWorker(RpcHost):
         depth = (_LEASE_PIPELINE_DEPTH
                  if state.svc_s is not None
                  and state.svc_s < _PIPELINE_FAST_TASK_S else 1)
+        # group this tick's assignments per lease: N tasks to one worker
+        # ride ONE push_tasks frame instead of N push RPCs (reference:
+        # direct task submission batches over the lease connection)
+        batches: Dict[int, Tuple[_Lease, List[_TaskState]]] = {}
         while state.pending and live:
             lease = min(live, key=lambda l: len(l.inflight))
             if len(lease.inflight) >= depth:
                 break
             task = state.pending.popleft()
-            self._assign(state, lease, task)
+            lease.inflight.append(task)
+            if lease.linger_handle is not None:
+                lease.linger_handle.cancel()
+                lease.linger_handle = None
+            batches.setdefault(id(lease), (lease, []))[1].append(task)
+        for lease, tasks in batches.values():
+            if len(tasks) == 1:
+                self._spawn(self._push(state, lease, tasks[0],
+                                       len(lease.inflight)))
+            else:
+                self._spawn(self._push_batch(state, lease, tasks))
         if not state.pending:
             # no demand: cancel outstanding lease requests — a stale
             # queued request would be granted later, linger idle, and
@@ -1314,14 +1403,6 @@ class CoreWorker(RpcHost):
             if not state.pending:
                 return
 
-    def _assign(self, state: _SchedState, lease: _Lease, task: _TaskState):
-        lease.inflight.append(task)
-        pos = len(lease.inflight)  # this task's position in the FIFO
-        if lease.linger_handle is not None:
-            lease.linger_handle.cancel()
-            lease.linger_handle = None
-        self._spawn(self._push(state, lease, task, pos))
-
     async def _push(self, state: _SchedState, lease: _Lease, task: _TaskState,
                     depth0: int = 1):
         t0 = time.perf_counter()
@@ -1363,6 +1444,84 @@ class CoreWorker(RpcHost):
         except ValueError:
             pass
         self._pump(state)
+
+    async def _push_batch(self, state: _SchedState, lease: _Lease,
+                          tasks: List[_TaskState]):
+        """One push_tasks frame carrying N specs (this tick's assignments
+        to one lease).  The worker executes FIFO and pushes each result
+        back the moment it completes ("batch_result" — handled in
+        _on_exec_worker_push, which removes the task from inflight), so
+        failure semantics stay identical to per-task _push: on worker
+        death, results that arrived were already processed, the task at
+        inflight[0] is the one actually running, and only it is charged
+        a retry."""
+        t0 = time.perf_counter()
+        base = len(lease.inflight) - len(tasks)
+        for i, task in enumerate(tasks):
+            self._batch_pending[task.spec.task_id] = (
+                "task", state, lease, task, t0, base + i + 1)
+        try:
+            c = await self._aclient_worker(lease.addr)
+            await c.call(
+                "push_tasks", specs=[t.spec.to_wire() for t in tasks],
+                tpu_chips=lease.tpu_chips, timeout=_TASK_PUSH_TIMEOUT)
+        except (ConnectionLost, RpcError, Exception) as e:
+            self._drop_lease(state, lease, kill=True)
+            requeue: List[_TaskState] = []
+            for task in tasks:
+                if self._batch_pending.pop(task.spec.task_id, None) is None:
+                    continue  # its result arrived before the death
+                started = lease.failed_head is task
+                try:
+                    lease.inflight.remove(task)
+                except ValueError:
+                    pass
+                if self._take_cancelled(task):
+                    continue
+                if not started or task.retries_left != 0:
+                    if started and task.retries_left > 0:
+                        task.retries_left -= 1
+                    requeue.append(task)
+                else:
+                    self._fail_task(task, RayWorkerError(
+                        f"worker {lease.worker_id[:8]} died running "
+                        f"{task.spec.name or task.spec.function_id[:8]}: {e}"))
+            if requeue:
+                await self._sleep(config.task_retry_delay_ms / 1000.0)
+                state.pending.extendleft(reversed(requeue))
+            self._pump(state)
+            return
+        # ordered connection: every batch_result was dispatched (and its
+        # registration popped) before this reply resolved — nothing to do
+        self._pump(state)
+
+    async def _finish_batch_items(self, work: List[tuple]):
+        """Process a frame's worth of batched-push results; pump each
+        touched scheduling state / actor once at the end, not per item."""
+        states = {}
+        astates = {}
+        now = time.perf_counter()
+        for entry, reply in work:
+            if entry[0] == "task":
+                _, state, lease, task, t0, depth0 = entry
+                svc = (now - t0) / max(1, depth0)
+                state.svc_s = svc if state.svc_s is None \
+                    else 0.5 * (state.svc_s + svc)
+                await self._process_reply(task, reply, lease.addr)
+                try:
+                    lease.inflight.remove(task)
+                except ValueError:
+                    pass
+                states[id(state)] = state
+            else:  # actor
+                _, astate, task, addr = entry
+                await self._process_reply(task, reply, addr)
+                astate.inflight.pop(task.spec.seqno, None)
+                astates[id(astate)] = astate
+        for state in states.values():
+            self._pump(state)
+        for astate in astates.values():
+            await self._actor_pump(astate)
 
     async def _sleep(self, s: float):
         import asyncio
@@ -1581,20 +1740,43 @@ class CoreWorker(RpcHost):
         for oid in task.return_oids:
             self.memory.ensure(oid)
             refs.append(ObjectRef(oid, owner_addr=self.address))
-        self._spawn(self._actor_submit(astate, task))
+        try:
+            self._loop().call_soon_threadsafe(self._actor_enqueue,
+                                              astate, task)
+        except RuntimeError:
+            pass  # loop shut down
         return refs
 
-    async def _actor_submit(self, astate: _ActorState, task: _TaskState):
+    def _actor_enqueue(self, astate: _ActorState, task: _TaskState) -> None:
+        """Loop-side enqueue: assigns the seqno (in submission order —
+        call_soon_threadsafe preserves caller order) and either marks the
+        call ready or spawns dependency resolution.  Pumping is coalesced
+        so rapid-fire calls form push_tasks batches (reference:
+        direct_actor_task_submitter.h sequence numbers)."""
         if astate.dead:
-            self._fail_task(task, ActorDiedError(astate.death_cause or "actor is dead"))
+            self._fail_task(task, ActorDiedError(
+                astate.death_cause or "actor is dead"))
             return
         task.spec.seqno = astate.seq
         astate.seq += 1
         # enqueue BEFORE resolving deps so per-handle submission order is
         # preserved even when an earlier call waits on a pending ref
-        # (reference: direct_actor_task_submitter.h sequence numbers)
-        task.deps_ready = False
-        astate.pending.append(task)
+        if any(a.object_id is not None for a in task.spec.args):
+            task.deps_ready = False
+            astate.pending.append(task)
+            self._spawn(self._actor_resolve_then_pump(astate, task))
+        else:
+            astate.pending.append(task)
+            if not astate.pump_queued:
+                astate.pump_queued = True
+                self._loop().call_soon(self._actor_coalesced_pump, astate)
+
+    def _actor_coalesced_pump(self, astate: _ActorState) -> None:
+        astate.pump_queued = False
+        self._spawn(self._actor_pump(astate))
+
+    async def _actor_resolve_then_pump(self, astate: _ActorState,
+                                       task: _TaskState):
         ok = await self._resolve_deps(task)
         if not ok:
             try:
@@ -1629,11 +1811,19 @@ class CoreWorker(RpcHost):
                     fut.set_result(None)
             if astate.dead or astate.recovering:
                 return
+        batch: List[_TaskState] = []
         while astate.pending and astate.pending[0].deps_ready \
                 and len(astate.inflight) < _MAX_ACTOR_INFLIGHT:
             task = astate.pending.popleft()
             astate.inflight[task.spec.seqno] = task
-            self._spawn(self._actor_push(astate, task, astate.instance))
+            batch.append(task)
+        if len(batch) == 1:
+            self._spawn(self._actor_push(astate, batch[0], astate.instance))
+        elif batch:
+            # one push_tasks frame for this tick's ready calls — the
+            # worker executes FIFO so seqno order is preserved
+            self._spawn(self._actor_push_batch(astate, batch,
+                                               astate.instance))
 
     async def _actor_resolve(self, astate: _ActorState, known_instance: int = -1):
         try:
@@ -1679,13 +1869,42 @@ class CoreWorker(RpcHost):
             reply = await c.call("push_task", spec=task.spec.to_wire(),
                                  timeout=_TASK_PUSH_TIMEOUT)
         except (ConnectionLost, Exception) as e:
-            await self._actor_recover(astate, task, instance, e)
+            await self._actor_recover(astate, [task], instance, e)
             return
         # the snapshot, NOT astate.addr: a concurrent recovery may have
         # cleared/re-pointed the live field while we awaited the reply,
         # and borrows/acks must go to the worker that actually executed
         await self._process_reply(task, reply, addr)
         astate.inflight.pop(task.spec.seqno, None)
+        await self._actor_pump(astate)
+
+    async def _actor_push_batch(self, astate: _ActorState,
+                                tasks: List[_TaskState], instance: int):
+        """Batched actor push: one push_tasks frame for this tick's ready
+        calls (FIFO on the worker preserves seqno order).  Per-task
+        results arrive as "batch_result" pushes, so calls that completed
+        before an actor death are never re-executed."""
+        addr = astate.addr
+        if addr is None:
+            for task in tasks:
+                astate.inflight.pop(task.spec.seqno, None)
+                self._actor_requeue(astate, task)
+            await self._actor_pump(astate)
+            return
+        for task in tasks:
+            self._batch_pending[task.spec.task_id] = (
+                "actor", astate, task, addr)
+        try:
+            c = await self._aclient_worker(addr)
+            await c.call("push_tasks",
+                         specs=[t.spec.to_wire() for t in tasks],
+                         timeout=_TASK_PUSH_TIMEOUT)
+        except (ConnectionLost, Exception) as e:
+            unfinished = [t for t in tasks
+                          if self._batch_pending.pop(t.spec.task_id, None)
+                          is not None]
+            await self._actor_recover(astate, unfinished, instance, e)
+            return
         await self._actor_pump(astate)
 
     def _actor_requeue(self, astate: _ActorState, task: _TaskState) -> None:
@@ -1703,20 +1922,23 @@ class CoreWorker(RpcHost):
             astate.pending = deque(
                 sorted(astate.pending, key=lambda t: t.spec.seqno))
 
-    async def _actor_recover(self, astate: _ActorState, task: _TaskState,
+    async def _actor_recover(self, astate: _ActorState,
+                             tasks: List[_TaskState],
                              instance: int, error: Exception):
         """Connection to the actor failed mid-call."""
-        astate.inflight.pop(task.spec.seqno, None)
-        if self._take_cancelled(task):
-            pass
-        elif task.retries_left != 0:
-            if task.retries_left > 0:
-                task.retries_left -= 1
-            # retryable: requeued, re-sent after re-resolve
-            self._actor_requeue(astate, task)
-        else:
-            self._fail_task(task, ActorDiedError(
-                f"actor task {task.spec.method_name} failed: worker died ({error})"))
+        for task in tasks:
+            astate.inflight.pop(task.spec.seqno, None)
+            if self._take_cancelled(task):
+                continue
+            if task.retries_left != 0:
+                if task.retries_left > 0:
+                    task.retries_left -= 1
+                # retryable: requeued, re-sent after re-resolve
+                self._actor_requeue(astate, task)
+            else:
+                self._fail_task(task, ActorDiedError(
+                    f"actor task {task.spec.method_name} failed: "
+                    f"worker died ({error})"))
         if astate.recovering or astate.dead:
             return
         astate.recovering = True
@@ -1749,16 +1971,7 @@ class CoreWorker(RpcHost):
 
     # ------------------------------------------------------- task execution
 
-    async def rpc_push_task(self, spec: Dict[str, Any], instance: int = 0,
-                            tpu_chips: Optional[List[int]] = None,
-                            _conn=None):
-        """Execute a pushed task (worker mode). Runs user code on the exec
-        thread; this handler awaits completion and carries the results back
-        in the reply (reference: core_worker.proto PushTask)."""
-        import asyncio
-
-        import os
-
+    def _apply_chip_env(self, tpu_chips: Optional[List[int]]) -> None:
         if tpu_chips:
             # the lease's node agent assigned these chips; jax reads
             # TPU_VISIBLE_CHIPS at (lazy) plugin init so tasks sharing a
@@ -1771,10 +1984,85 @@ class CoreWorker(RpcHost):
             # actor METHOD pushes — leaves the constructor's assignment
             # intact for the actor's lifetime.
             os.environ.pop("TPU_VISIBLE_CHIPS", None)
+
+    def _enqueue_exec(self, spec: Dict[str, Any], conn) -> "asyncio.Future":
         fut = self._loop().create_future()
         self._exec_pending.add(spec.get("tid", ""))
-        self._task_queue.put((spec, fut, _conn))
-        return await fut
+        self._task_queue.put((spec, fut, conn))
+        return fut
+
+    async def rpc_push_task(self, spec: Dict[str, Any], instance: int = 0,
+                            tpu_chips: Optional[List[int]] = None,
+                            _conn=None):
+        """Execute a pushed task (worker mode). Runs user code on the exec
+        thread; this handler awaits completion and carries the results back
+        in the reply (reference: core_worker.proto PushTask)."""
+        self._apply_chip_env(tpu_chips)
+        return await self._enqueue_exec(spec, _conn)
+
+    async def rpc_push_tasks(self, specs: List[Dict[str, Any]],
+                             instance: int = 0,
+                             tpu_chips: Optional[List[int]] = None,
+                             _conn=None):
+        """Batched push: N specs in one frame, executed FIFO (reference:
+        the lease connection batching in direct_task_transport).
+
+        Each task's result is pushed back ("batch_result" oneway) the
+        moment it completes — NOT withheld until the whole batch is done —
+        so the owner's failure accounting behaves exactly like per-task
+        pushes: on a mid-batch worker death, finished results were
+        already delivered and only the actually-running task is charged
+        a retry.  The final reply is a bare completion marker."""
+        import asyncio as _aio
+
+        self._apply_chip_env(tpu_chips)
+        futs = []
+        for spec in specs:
+            fut = self._enqueue_exec(spec, _conn)
+            if _conn is not None:
+                def _send(f, tid=spec.get("tid", "")):
+                    self._queue_batch_result(_conn, tid, f.result())
+                fut.add_done_callback(_send)
+            futs.append(fut)
+        await _aio.gather(*futs)
+        if _conn is not None:
+            # anything still buffered goes out BEFORE the completion
+            # reply — the owner may treat the reply as "all results in"
+            await self._drain_batch_results(_conn)
+        return {"done": len(specs)}
+
+    def _queue_batch_result(self, conn, tid: str, reply: Dict[str, Any]):
+        """Micro-batch per-task results: flush when 32 are buffered or
+        5ms after the first, whichever comes first.  Trivial-task bursts
+        coalesce many results per frame (frames, not payload bytes, are
+        what cap small-task throughput); the 5ms ceiling is noise next
+        to any non-trivial task's runtime."""
+        key = id(conn)
+        ent = self._result_bufs.get(key)
+        if ent is None:
+            self._result_bufs[key] = (conn, [{"tid": tid, "reply": reply}])
+            self._loop().call_later(0.005, self._flush_batch_results, key)
+        else:
+            ent[1].append({"tid": tid, "reply": reply})
+            if len(ent[1]) >= 32:
+                self._flush_batch_results(key)
+
+    def _flush_batch_results(self, key: int) -> None:
+        import asyncio as _aio
+
+        ent = self._result_bufs.pop(key, None)
+        if ent is None:
+            return
+        conn, items = ent
+        _aio.ensure_future(conn.push("batch_results", {"items": items}))
+
+    async def _drain_batch_results(self, conn) -> None:
+        ent = self._result_bufs.pop(id(conn), None)
+        if ent is not None:
+            try:
+                await conn.push("batch_results", {"items": ent[1]})
+            except Exception:
+                pass
 
     async def rpc_cancel_task(self, task_id: str, force: bool = False):
         """Owner requests cancellation of a task pushed to this worker
@@ -1824,23 +2112,40 @@ class CoreWorker(RpcHost):
         can fire here between tasks.  A stale cancellation must not kill
         this thread (the worker would silently stop serving pushes)."""
         while True:
+            item = None
+            reply = None
             try:
                 item = self._task_queue.get()
+                if item is None:
+                    # propagate shutdown to any extra concurrency threads
+                    for _ in self._exec_threads:
+                        self._task_queue.put(None)
+                    return
+                try:
+                    reply = self._execute(item[0], item[2])
+                except BaseException as e:  # _execute never raises by design
+                    reply = self._error_reply(TaskSpec.from_wire(item[0]), e,
+                                              traceback.format_exc())
+                self._post_exec_reply(item[1], reply)
             except TaskCancelledError:
-                continue  # stale async-exc from an already-finished task
-            if item is None:
-                # propagate shutdown to any extra concurrency threads
-                for _ in self._exec_threads:
-                    self._task_queue.put(None)
-                break
-            spec_wire, fut, conn = item
-            try:
-                reply = self._execute(spec_wire, conn)
-            except BaseException as e:  # _execute never raises by design
-                reply = self._error_reply(TaskSpec.from_wire(spec_wire), e,
-                                          traceback.format_exc())
-            self._loop().call_soon_threadsafe(
-                lambda f=fut, r=reply: (not f.done()) and f.set_result(r))
+                # stale async-exc from an already-finished task fired
+                # between tasks (or on the reply-post line): swallow it —
+                # and still deliver the computed reply so the owner's
+                # push never hangs on a lost future
+                if item is not None:
+                    if reply is None:
+                        reply = self._error_reply(
+                            TaskSpec.from_wire(item[0]), RayWorkerError(
+                                "exec interrupted by stale cancel"), "")
+                    try:
+                        self._post_exec_reply(item[1], reply)
+                    except Exception:
+                        pass
+                continue
+
+    def _post_exec_reply(self, fut, reply) -> None:
+        self._loop().call_soon_threadsafe(
+            lambda f=fut, r=reply: (not f.done()) and f.set_result(r))
 
     def _start_concurrency_threads(self, n: int):
         """Extra executors for actors with max_concurrency > 1

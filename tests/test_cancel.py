@@ -133,3 +133,25 @@ def test_cancel_streaming_generator(cluster):
                         StopIteration)):
         for _ in range(200):
             next(g)
+
+
+def test_cancel_while_args_resolving(cluster):
+    """A task whose ref args are still being produced is cancellable —
+    it must never run (regression: it was in no queue during dep
+    resolution and cancel was a silent no-op)."""
+    @ray_tpu.remote
+    def slow_dep():
+        import time as t
+        t.sleep(2)
+        return 1
+
+    @ray_tpu.remote
+    def consumer(x):
+        return "ran"
+
+    dep = slow_dep.remote()
+    ref = consumer.remote(dep)
+    time.sleep(0.2)  # consumer is waiting on dep
+    ray_tpu.cancel(ref)
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
