@@ -69,6 +69,9 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     lib.parallel_touch.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
                                    ctypes.c_int]
     lib.parallel_touch.restype = None
+    lib.parallel_touch_write.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                         ctypes.c_int]
+    lib.parallel_touch_write.restype = None
     lib.fl_new.argtypes = [ctypes.c_size_t]
     lib.fl_new.restype = ctypes.c_void_p
     lib.fl_destroy.argtypes = [ctypes.c_void_p]
@@ -222,3 +225,17 @@ def touch_pages(view) -> None:
         return
     addr, n = _addr_len(view, writable=False)
     lib.parallel_touch(addr, n, _COPY_THREADS)
+
+
+def touch_pages_write(view) -> None:
+    """WRITE-fault one byte per page (content-preserving): installs
+    writable PTEs in one pass, for regions the caller owns and is about
+    to overwrite (plasma put).  Parallel when native is loaded."""
+    lib = _get_lib()
+    if lib is None:
+        mv = memoryview(view)
+        sl = mv[::4096]
+        sl[:] = bytes(sl)  # read + write back the same bytes
+        return
+    addr, n = _addr_len(view, writable=True)
+    lib.parallel_touch_write(addr, n, _COPY_THREADS)

@@ -76,6 +76,53 @@ static void *touch_run(void *arg) {
     return 0;
 }
 
+typedef struct {
+    volatile char *p;
+    size_t n;
+} touchw_job_t;
+
+static void *touchw_run(void *arg) {
+    touchw_job_t *j = (touchw_job_t *)arg;
+    for (size_t off = 0; off < j->n; off += 4096)
+        j->p[off] = j->p[off]; /* volatile: not elided; preserves bytes */
+    return 0;
+}
+
+/* WRITE-fault one byte per page: installs writable PTEs in one pass.
+ * A read-touch maps pages read-only and the following store still pays
+ * a write-protect upgrade fault per page; callers that are about to
+ * overwrite a region they own (plasma put) want this variant. */
+void parallel_touch_write(char *p, size_t n, int nthreads) {
+    if (nthreads < 2 || n < (size_t)(1 << 22)) {
+        touchw_job_t j = {p, n};
+        touchw_run(&j);
+        return;
+    }
+    if (nthreads > 64)
+        nthreads = 64;
+    pthread_t threads[64];
+    touchw_job_t jobs[64];
+    size_t chunk = (n + (size_t)nthreads - 1) / (size_t)nthreads;
+    chunk = (chunk + 4095) & ~(size_t)4095;
+    int started = 0;
+    for (int i = 0; i < nthreads; i++) {
+        size_t off = (size_t)i * chunk;
+        if (off >= n)
+            break;
+        jobs[started].p = p + off;
+        jobs[started].n = n - off < chunk ? n - off : chunk;
+        if (pthread_create(&threads[started], 0, touchw_run,
+                           &jobs[started]) != 0) {
+            touchw_job_t j = {p + off, n - off};
+            touchw_run(&j);
+            break;
+        }
+        started++;
+    }
+    for (int i = 0; i < started; i++)
+        pthread_join(threads[i], 0);
+}
+
 /* Read-fault one byte per page so a following write runs at memcpy
  * speed instead of write-fault speed (PTE setup for already-resident
  * tmpfs pages). */

@@ -400,15 +400,26 @@ class CoreWorker(RpcHost):
         consumer-facing ObjectRef resolves through the normal get path.
         """
         if method == "batch_results":
-            # pop registrations synchronously (the batch failure path
-            # relies on it), then process the whole frame in ONE
+            # pop registrations AND remove from inflight synchronously:
+            # the batch failure path snapshots failed_head from
+            # inflight[0], which must never point at a task whose result
+            # already arrived.  Then process the whole frame in ONE
             # coroutine — a Task per result would dominate small-task
-            # throughput
+            # throughput.
             work = []
             for item in payload.get("items") or []:
                 entry = self._batch_pending.pop(item.get("tid", ""), None)
-                if entry is not None:
-                    work.append((entry, item.get("reply")))
+                if entry is None:
+                    continue
+                if entry[0] == "task":
+                    lease, task = entry[2], entry[3]
+                    try:
+                        lease.inflight.remove(task)
+                    except ValueError:
+                        pass
+                else:
+                    entry[1].inflight.pop(entry[2].spec.seqno, None)
+                work.append((entry, item.get("reply")))
             if work:
                 asyncio.ensure_future(self._finish_batch_items(work))
             return
@@ -1412,26 +1423,10 @@ class CoreWorker(RpcHost):
                                  tpu_chips=lease.tpu_chips,
                                  timeout=_TASK_PUSH_TIMEOUT)
         except (ConnectionLost, RpcError, Exception) as e:
-            # only the task actually running (oldest in the worker's FIFO
-            # when it died) is charged a retry; tasks merely queued behind
-            # it were never started and requeue for free
             self._drop_lease(state, lease, kill=True)
-            started = lease.failed_head is task
-            try:
-                lease.inflight.remove(task)
-            except ValueError:
-                pass
-            if self._take_cancelled(task):
-                pass
-            elif not started or task.retries_left != 0:
-                if started and task.retries_left > 0:
-                    task.retries_left -= 1
+            if self._account_push_death(lease, task, e):
                 await self._sleep(config.task_retry_delay_ms / 1000.0)
                 state.pending.appendleft(task)
-            else:
-                self._fail_task(task, RayWorkerError(
-                    f"worker {lease.worker_id[:8]} died running "
-                    f"{task.spec.name or task.spec.function_id[:8]}: {e}"))
             self._pump(state)
             return
         # this push waited behind depth0-1 earlier tasks, so per-task
@@ -1444,6 +1439,30 @@ class CoreWorker(RpcHost):
         except ValueError:
             pass
         self._pump(state)
+
+    def _account_push_death(self, lease: _Lease, task: _TaskState,
+                            error: Exception) -> bool:
+        """Worker-death policy for one pushed task (shared by single and
+        batched pushes): only the task actually running (oldest in the
+        worker's FIFO when it died) is charged a retry; tasks merely
+        queued behind it were never started and requeue for free.
+        Returns True if the task should be requeued, False if it was
+        resolved (cancelled or failed)."""
+        started = lease.failed_head is task
+        try:
+            lease.inflight.remove(task)
+        except ValueError:
+            pass
+        if self._take_cancelled(task):
+            return False
+        if not started or task.retries_left != 0:
+            if started and task.retries_left > 0:
+                task.retries_left -= 1
+            return True
+        self._fail_task(task, RayWorkerError(
+            f"worker {lease.worker_id[:8]} died running "
+            f"{task.spec.name or task.spec.function_id[:8]}: {error}"))
+        return False
 
     async def _push_batch(self, state: _SchedState, lease: _Lease,
                           tasks: List[_TaskState]):
@@ -1467,25 +1486,10 @@ class CoreWorker(RpcHost):
                 tpu_chips=lease.tpu_chips, timeout=_TASK_PUSH_TIMEOUT)
         except (ConnectionLost, RpcError, Exception) as e:
             self._drop_lease(state, lease, kill=True)
-            requeue: List[_TaskState] = []
-            for task in tasks:
-                if self._batch_pending.pop(task.spec.task_id, None) is None:
-                    continue  # its result arrived before the death
-                started = lease.failed_head is task
-                try:
-                    lease.inflight.remove(task)
-                except ValueError:
-                    pass
-                if self._take_cancelled(task):
-                    continue
-                if not started or task.retries_left != 0:
-                    if started and task.retries_left > 0:
-                        task.retries_left -= 1
-                    requeue.append(task)
-                else:
-                    self._fail_task(task, RayWorkerError(
-                        f"worker {lease.worker_id[:8]} died running "
-                        f"{task.spec.name or task.spec.function_id[:8]}: {e}"))
+            requeue = [task for task in tasks
+                       if self._batch_pending.pop(task.spec.task_id, None)
+                       is not None  # else: result arrived before death
+                       and self._account_push_death(lease, task, e)]
             if requeue:
                 await self._sleep(config.task_retry_delay_ms / 1000.0)
                 state.pending.extendleft(reversed(requeue))
@@ -1496,8 +1500,9 @@ class CoreWorker(RpcHost):
         self._pump(state)
 
     async def _finish_batch_items(self, work: List[tuple]):
-        """Process a frame's worth of batched-push results; pump each
-        touched scheduling state / actor once at the end, not per item."""
+        """Process a frame's worth of batched-push results (inflight
+        bookkeeping already done synchronously in the push handler);
+        pump each touched scheduling state / actor once at the end."""
         states = {}
         astates = {}
         now = time.perf_counter()
@@ -1508,15 +1513,10 @@ class CoreWorker(RpcHost):
                 state.svc_s = svc if state.svc_s is None \
                     else 0.5 * (state.svc_s + svc)
                 await self._process_reply(task, reply, lease.addr)
-                try:
-                    lease.inflight.remove(task)
-                except ValueError:
-                    pass
                 states[id(state)] = state
             else:  # actor
                 _, astate, task, addr = entry
                 await self._process_reply(task, reply, addr)
-                astate.inflight.pop(task.spec.seqno, None)
                 astates[id(astate)] = astate
         for state in states.values():
             self._pump(state)
