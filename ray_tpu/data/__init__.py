@@ -7,9 +7,9 @@ store; transforms run as tasks; iteration streams with a bounded
 in-flight window (backpressure).
 """
 
-from ray_tpu.data.dataset import (ActorPoolStrategy, Dataset, from_items,
-                                  from_numpy, range, read_csv, read_json,
-                                  read_parquet)
+from ray_tpu.data.dataset import (ActorPoolStrategy, Dataset, GroupedData,
+                                  from_items, from_numpy, range, read_csv,
+                                  read_json, read_parquet)
 
-__all__ = ["ActorPoolStrategy", "Dataset", "from_items", "from_numpy",
-           "range", "read_parquet", "read_csv", "read_json"]
+__all__ = ["ActorPoolStrategy", "Dataset", "GroupedData", "from_items",
+           "from_numpy", "range", "read_parquet", "read_csv", "read_json"]

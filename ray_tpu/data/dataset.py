@@ -14,10 +14,11 @@ from __future__ import annotations
 import builtins
 import random as _random
 from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
-                    Union)
+                    Tuple, Union)
 
 import numpy as np
 
+from ray_tpu.data import logical
 from ray_tpu.data.block import BlockAccessor, block_from_numpy, build_block
 
 # streaming window bounds (resource-aware; see _stream_window)
@@ -172,6 +173,103 @@ def _sort_block(block, key: str, descending: bool):
     return block.take(idx)
 
 
+def _sort_sample(block, key: str, k: int = 20):
+    """A few key values per block — the only sort data the driver sees."""
+    col = block.column(key).to_pylist()
+    if len(col) <= k:
+        return col
+    return _random.sample(col, k)
+
+
+def _sort_map(block, key: str, bounds: List[Any], descending: bool,
+              n_out: int):
+    """Range-partition one block: sort ascending, cut at the sampled
+    boundaries; part j holds keys in [bounds[j-1], bounds[j])."""
+    import bisect
+
+    sorted_block = _sort_block(block, key, False)
+    col = sorted_block.column(key).to_pylist()
+    cuts = [bisect.bisect_left(col, b) for b in bounds] + [len(col)]
+    parts, prev = [], 0
+    for cut in cuts:
+        parts.append(sorted_block.slice(prev, cut - prev))
+        prev = cut
+    return tuple(parts) if n_out > 1 else parts[0]
+
+
+def _sort_reduce(key: str, descending: bool, *parts):
+    import pyarrow as pa
+
+    tables = [p for p in parts if p.num_rows > 0]
+    if not tables:
+        return build_block([])
+    return _sort_block(pa.concat_tables(tables), key, descending)
+
+
+def _stable_hash(value) -> int:
+    """Deterministic across processes (builtin hash() is seeded per
+    interpreter, which would scatter one group over many partitions)."""
+    import zlib
+
+    return zlib.crc32(repr(value).encode("utf-8", "backslashreplace"))
+
+
+def _groupby_map(block, key: str, n_out: int):
+    """Hash-partition one block's rows by group key."""
+    parts: List[List[dict]] = [[] for _ in builtins.range(n_out)]
+    for r in BlockAccessor(block).to_rows():
+        parts[_stable_hash(r[key]) % n_out].append(r)
+    out = tuple(build_block(p) for p in parts)
+    return out if n_out > 1 else out[0]
+
+
+def _group_rows(key: str, parts) -> Dict[Any, List[dict]]:
+    groups: Dict[Any, List[dict]] = {}
+    for p in parts:
+        for r in BlockAccessor(p).to_rows():
+            groups.setdefault(r[key], []).append(r)
+    return groups
+
+
+def _groupby_agg(key: str, specs: List[Tuple[str, Optional[str]]], *parts):
+    """Per-partition aggregation: every row of a group is local here
+    (hash partitioning), so each agg is a plain in-memory fold."""
+    out_rows = []
+    groups = _group_rows(key, parts)
+    for k in sorted(groups.keys(), key=repr):
+        rows = groups[k]
+        row = {key: k}
+        for kind, on in specs:
+            if kind == "count":
+                row["count()"] = len(rows)
+                continue
+            vals = np.asarray([r[on] for r in rows], dtype=np.float64)
+            if kind == "sum":
+                row[f"sum({on})"] = float(vals.sum())
+            elif kind == "min":
+                row[f"min({on})"] = float(vals.min())
+            elif kind == "max":
+                row[f"max({on})"] = float(vals.max())
+            elif kind == "mean":
+                row[f"mean({on})"] = float(vals.mean())
+            elif kind == "std":
+                row[f"std({on})"] = float(vals.std(ddof=1)) \
+                    if len(vals) > 1 else 0.0
+            else:
+                raise ValueError(f"unknown aggregate {kind!r}")
+        out_rows.append(row)
+    return build_block(out_rows)
+
+
+def _groupby_apply(key: str, fn, *parts):
+    """map_groups: the UDF sees all rows of one group, returns rows."""
+    out_rows = []
+    groups = _group_rows(key, parts)
+    for k in sorted(groups.keys(), key=repr):
+        out_rows.extend(fn(groups[k]))
+    return build_block(out_rows)
+
+
 def _read_file_task(path: str, fmt: str):
     import pyarrow as pa
 
@@ -201,16 +299,42 @@ def _write_parquet_task(block, path: str):
 
 
 class Dataset:
-    def __init__(self, block_refs: List[Any], ops: Optional[List[_Op]] = None):
+    def __init__(self, block_refs: List[Any],
+                 plan: Optional[List["logical.LogicalOp"]] = None):
         self._block_refs = block_refs   # source blocks (ObjectRefs)
-        self._ops: List[_Op] = ops or []
+        # the LOGICAL plan: transforms append LogicalOp nodes; the
+        # executor consumes the rewritten (rule-optimized) plan — see
+        # data/logical.py (reference: _internal/logical/ + planner/)
+        self._logical: List[logical.LogicalOp] = plan or []
+        self._ops_cache: Optional[List[_Op]] = None
         self._materialized: Optional[List[Any]] = None
         self._last_stats: Dict[str, Any] = {}
 
     # ---- plan building ----
 
+    @property
+    def _ops(self) -> List[_Op]:
+        """Physical fused op chain, derived by running the rewrite rules
+        over the logical plan (FuseMapOperators collapses the map-likes
+        into one task-per-block chain).  Cached: the plan is immutable
+        after construction (_chain builds a NEW Dataset)."""
+        if self._ops_cache is None:
+            ops: List[_Op] = []
+            for node in logical.optimize(self._logical):
+                if node.name == "fused_map":
+                    ops.extend(node.payload)
+                else:
+                    # fail loudly: a plan node the executor doesn't know
+                    # must never silently vanish from execution
+                    raise ValueError(
+                        f"no physical execution for logical op "
+                        f"{node.name!r}")
+            self._ops_cache = ops
+        return self._ops_cache
+
     def _chain(self, op: _Op) -> "Dataset":
-        return Dataset(self._block_refs, self._ops + [op])
+        return Dataset(self._block_refs,
+                       self._logical + [logical.LogicalOp(op.kind, op)])
 
     def map_batches(self, fn: Callable[[Dict[str, np.ndarray]], Any],
                     batch_size: Optional[int] = None,
@@ -298,9 +422,12 @@ class Dataset:
         return len(self._block_refs)
 
     def explain(self) -> str:
-        """Human-readable logical plan: source blocks -> fused op chain
-        (reference: the planner's plan dump, _internal/planner/)."""
+        """Human-readable plan: the logical op list, then the
+        rule-rewritten plan the executor runs (reference: the planner's
+        plan dump, _internal/planner/planner.py)."""
         lines = [f"Source[{len(self._block_refs)} blocks]"]
+        if self._logical:
+            lines.append("  logical:   " + logical.describe(self._logical))
         fused: List[str] = []
         for op in self._ops:
             label = op.kind
@@ -313,7 +440,7 @@ class Dataset:
                 label += f"({getattr(op.fn, '__name__', 'fn')})"
             fused.append(label)
         if fused:
-            lines.append("  -> Fused[" + " | ".join(fused) + "]"
+            lines.append("  optimized: Fused[" + " | ".join(fused) + "]"
                          + (" per-block task" if not self._has_actor_op()
                             else " on actor pool"))
         return "\n".join(lines)
@@ -545,13 +672,45 @@ class Dataset:
         return Dataset(out)
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
-        """Global sort: per-block sort + driver-side merge of boundaries
-        (small-data path; range partitioning lands with larger scale)."""
-        rows = self.take_all()
-        rows.sort(key=lambda r: r[key], reverse=descending)
+        """Global sort via sample-based range partitioning: sample keys
+        from every block (only the SAMPLES touch the driver), cut N-1
+        boundaries, range-partition each block in a map task, merge each
+        range in a reduce task.  Block i of the result holds range i, so
+        concatenation order IS global order — no driver-side row merge
+        (reference: _internal/planner/exchange/sort_task_spec.py
+        SortTaskSpec.sample_boundaries + push_based_shuffle)."""
         import ray_tpu
 
-        return Dataset([ray_tpu.put(build_block(rows))])
+        refs = self._execute()
+        n = len(refs)
+        if n == 0:
+            return Dataset([])
+        if n == 1:
+            sorter = _remote_sort_block()
+            return Dataset([sorter.remote(refs[0], key, descending)])
+        sampler = _remote_sort_sample()
+        samples = ray_tpu.get(
+            [sampler.remote(r, key) for r in refs], timeout=600)
+        merged = sorted(v for s in samples for v in s)
+        if not merged:
+            return Dataset(refs)
+        # n-1 equi-spaced boundaries over the sampled key distribution
+        bounds = [merged[(i * len(merged)) // n]
+                  for i in builtins.range(1, n)]
+        mapper = _remote_sort_map(n)
+        parts = [mapper.remote(r, key, bounds, descending, n) for r in refs]
+        reducer = _remote_sort_reduce()
+        out = [reducer.remote(key, descending,
+                              *[parts[i][j] for i in builtins.range(n)])
+               for j in builtins.range(n)]
+        if descending:
+            out.reverse()
+        return Dataset(out)
+
+    def groupby(self, key: str) -> "GroupedData":
+        """Group rows by a column for per-group aggregation
+        (reference: python/ray/data/grouped_data.py:36 GroupedData)."""
+        return GroupedData(self, key)
 
     def union(self, *others: "Dataset") -> "Dataset":
         refs = list(self._execute())
@@ -590,18 +749,75 @@ class Dataset:
                 f"pending_ops={len(self._ops)})")
 
 
+class GroupedData:
+    """Distributed group-by: rows hash-partition by key in map tasks, so
+    each reduce task holds every row of its groups and aggregates (or
+    applies a UDF) locally — no group's rows ever gather on the driver
+    (reference: python/ray/data/grouped_data.py:36; the hash exchange in
+    _internal/planner/exchange/).
+
+    Aggregates: count(), sum/min/max/mean/std(on), multi-agg via
+    aggregate(("sum", "x"), ("max", "y")); per-group UDFs via
+    map_groups(fn) where fn(list-of-rows) -> list-of-rows.
+    """
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _partitions(self) -> List[List[Any]]:
+        """Hash-exchange the dataset: returns per-partition part lists
+        (partition j = parts[i][j] over every input block i)."""
+        refs = self._ds._execute()
+        n = len(refs)
+        if n == 0:
+            return []
+        mapper = _remote_groupby_map(n)
+        parts = [mapper.remote(r, self._key, n) for r in refs]
+        if n == 1:
+            parts = [[p] for p in parts]
+        return [[parts[i][j] for i in builtins.range(n)]
+                for j in builtins.range(n)]
+
+    def aggregate(self, *specs: Tuple[str, Optional[str]]) -> Dataset:
+        """Each spec is ("count", None) or ("sum"|"min"|"max"|"mean"|
+        "std", column); output has one row per group with columns like
+        "sum(x)" (reference: AggregateFn result naming)."""
+        if not specs:
+            raise ValueError("aggregate() needs at least one spec")
+        agg = _remote_groupby_agg()
+        out = [agg.remote(self._key, list(specs), *plist)
+               for plist in self._partitions()]
+        return Dataset(out)
+
+    def count(self) -> Dataset:
+        return self.aggregate(("count", None))
+
+    def sum(self, on: str) -> Dataset:
+        return self.aggregate(("sum", on))
+
+    def min(self, on: str) -> Dataset:
+        return self.aggregate(("min", on))
+
+    def max(self, on: str) -> Dataset:
+        return self.aggregate(("max", on))
+
+    def mean(self, on: str) -> Dataset:
+        return self.aggregate(("mean", on))
+
+    def std(self, on: str) -> Dataset:
+        return self.aggregate(("std", on))
+
+    def map_groups(self, fn: Callable[[List[dict]], List[dict]]) -> Dataset:
+        apply = _remote_groupby_apply()
+        out = [apply.remote(self._key, fn, *plist)
+               for plist in self._partitions()]
+        return Dataset(out)
+
+
 # -------------------------------------------------- remote fn construction
 
 _remote_cache: Dict[str, Any] = {}
-
-
-def _remote_fused():
-    fn = _remote_cache.get("fused")
-    if fn is None:
-        import ray_tpu
-
-        fn = _remote_cache["fused"] = ray_tpu.remote(_fused_block_task)
-    return fn
 
 
 def _fused_stream_task(refs, ops):
@@ -613,52 +829,83 @@ def _fused_stream_task(refs, ops):
         yield _apply_ops(ray_tpu.get(r), ops)
 
 
+def _remote_fused():
+    return _remote_simple("fused", _fused_block_task)
+
+
 def _remote_fused_stream():
-    fn = _remote_cache.get("fused_stream")
-    if fn is None:
-        import ray_tpu
-
-        fn = _remote_cache["fused_stream"] = ray_tpu.remote(
-            num_returns="streaming")(_fused_stream_task)
-    return fn
-
-
-def _remote_shuffle_map(n_out: int):
-    key = f"smap{n_out}"
+    key = "fused_stream"
     fn = _remote_cache.get(key)
     if fn is None:
         import ray_tpu
 
         fn = _remote_cache[key] = ray_tpu.remote(
-            num_returns=n_out)(_shuffle_map)
+            num_returns="streaming")(_fused_stream_task)
     return fn
+
+
+def _remote_simple(name: str, fn):
+    key = f"simple:{name}"
+    cached = _remote_cache.get(key)
+    if cached is None:
+        import ray_tpu
+
+        cached = _remote_cache[key] = ray_tpu.remote(fn)
+    return cached
+
+
+def _remote_multi(name: str, fn, n_out: int):
+    key = f"multi:{name}:{n_out}"
+    cached = _remote_cache.get(key)
+    if cached is None:
+        import ray_tpu
+
+        cached = _remote_cache[key] = ray_tpu.remote(num_returns=n_out)(fn)
+    return cached
+
+
+def _remote_sort_block():
+    return _remote_simple("sort_block", _sort_block)
+
+
+def _remote_sort_sample():
+    return _remote_simple("sort_sample", _sort_sample)
+
+
+def _remote_sort_map(n_out: int):
+    return _remote_multi("sort_map", _sort_map, n_out)
+
+
+def _remote_sort_reduce():
+    return _remote_simple("sort_reduce", _sort_reduce)
+
+
+def _remote_groupby_map(n_out: int):
+    return _remote_multi("groupby_map", _groupby_map, n_out)
+
+
+def _remote_groupby_agg():
+    return _remote_simple("groupby_agg", _groupby_agg)
+
+
+def _remote_groupby_apply():
+    return _remote_simple("groupby_apply", _groupby_apply)
+
+
+def _remote_shuffle_map(n_out: int):
+    return _remote_multi("shuffle_map", _shuffle_map, n_out)
 
 
 def _remote_shuffle_reduce():
-    fn = _remote_cache.get("sreduce")
-    if fn is None:
-        import ray_tpu
-
-        fn = _remote_cache["sreduce"] = ray_tpu.remote(_shuffle_reduce)
-    return fn
+    return _remote_simple("shuffle_reduce", _shuffle_reduce)
 
 
 def _remote_writer():
-    fn = _remote_cache.get("writer")
-    if fn is None:
-        import ray_tpu
-
-        fn = _remote_cache["writer"] = ray_tpu.remote(_write_parquet_task)
-    return fn
+    return _remote_simple("writer", _write_parquet_task)
 
 
 def _remote_reader():
-    fn = _remote_cache.get("reader")
-    if fn is None:
-        import ray_tpu
-
-        fn = _remote_cache["reader"] = ray_tpu.remote(_read_file_task)
-    return fn
+    return _remote_simple("reader", _read_file_task)
 
 
 # ------------------------------------------------------------ constructors

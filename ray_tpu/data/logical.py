@@ -1,0 +1,91 @@
+"""Logical plan + rewrite rules for ray_tpu.data.
+
+Small re-imagining of the reference's logical/physical planner split
+(reference: python/ray/data/_internal/logical/interfaces.py LogicalPlan
++ Rule; rules/operator_fusion.py OperatorFusionRule;
+planner/planner.py): a Dataset accumulates LogicalOp nodes; before
+execution the plan runs through an ordered list of rewrite rules, and
+the physical executor consumes the rewritten plan.  Today's rules:
+
+  * FuseMapOperators — adjacent per-row/per-batch transforms collapse
+    into one ``fused_map`` node executed as a single task (or actor
+    call) per block, the fusion the reference expresses in
+    operator_fusion.py.
+
+The rule list is the extension seam: later rules (predicate pushdown,
+limit pushdown, exchange planning) append here without touching the
+Dataset surface.  The executor fails loudly on plan nodes it has no
+physical translation for, so a new rule cannot silently drop work.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, List, Optional
+
+# map-like ops are fusable: one task per block applies the whole chain
+MAP_LIKE = ("map", "map_batches", "flat_map", "filter")
+
+
+class LogicalOp:
+    """One node of the (linear, for now) logical plan."""
+
+    __slots__ = ("name", "payload")
+
+    def __init__(self, name: str, payload: Any = None):
+        self.name = name
+        self.payload = payload  # _Op for map-likes; op params otherwise
+
+    def describe(self) -> str:
+        if self.name == "fused_map":
+            inner = ", ".join(getattr(o.fn, "__name__", o.kind)
+                              for o in self.payload)
+            return f"FusedMap[{inner}]"
+        if self.payload is not None and hasattr(self.payload, "kind"):
+            fn = getattr(self.payload.fn, "__name__", "fn")
+            return f"{self.name.title()}({fn})"
+        return self.name.title()
+
+
+class Rule(ABC):
+    """A plan-to-plan rewrite (reference: logical/interfaces.py Rule)."""
+
+    name: str = "rule"
+
+    @abstractmethod
+    def apply(self, ops: List[LogicalOp]) -> List[LogicalOp]:
+        ...
+
+
+class FuseMapOperators(Rule):
+    """Collapse adjacent map-like ops into one fused_map node so the
+    executor runs the whole chain as a single task per block
+    (reference: rules/operator_fusion.py)."""
+
+    name = "fuse_map_operators"
+
+    def apply(self, ops: List[LogicalOp]) -> List[LogicalOp]:
+        out: List[LogicalOp] = []
+        for op in ops:
+            if op.name in MAP_LIKE:
+                if out and out[-1].name == "fused_map":
+                    out[-1].payload.append(op.payload)
+                else:
+                    out.append(LogicalOp("fused_map", [op.payload]))
+            else:
+                out.append(op)
+        return out
+
+
+DEFAULT_RULES: List[Rule] = [FuseMapOperators()]
+
+
+def optimize(ops: List[LogicalOp],
+             rules: Optional[List[Rule]] = None) -> List[LogicalOp]:
+    for rule in (rules if rules is not None else DEFAULT_RULES):
+        ops = rule.apply(ops)
+    return ops
+
+
+def describe(ops: List[LogicalOp]) -> str:
+    return " -> ".join(op.describe() for op in ops) or "(empty)"

@@ -166,3 +166,80 @@ def test_explain_and_stats(cluster):
     stats = ds.stats()
     assert stats["blocks"] == 4 and stats["rows"] == 10
     assert stats["wall_s"] > 0
+
+
+def test_distributed_sort_multiblock(cluster):
+    """Sample-based range-partition sort: result blocks are ordered
+    ranges — no driver-side row merge (reference:
+    _internal/planner/exchange/sort_task_spec.py)."""
+    import random as _r
+
+    vals = list(range(200))
+    _r.Random(7).shuffle(vals)
+    ds = rtd.from_items([{"v": v} for v in vals], num_blocks=6).sort("v")
+    assert [r["v"] for r in ds.take_all()] == list(range(200))
+    # block count preserved (one block per range, not one driver blob)
+    assert ds.num_blocks() == 6
+
+    desc = rtd.from_items([{"v": v} for v in vals],
+                          num_blocks=5).sort("v", descending=True)
+    assert [r["v"] for r in desc.take_all()] == list(range(199, -1, -1))
+
+
+def test_sort_with_duplicate_keys(cluster):
+    rows = [{"k": i % 4, "p": i} for i in range(40)]
+    ds = rtd.from_items(rows, num_blocks=4).sort("k")
+    ks = [r["k"] for r in ds.take_all()]
+    assert ks == sorted(ks)
+    assert len(ks) == 40
+
+
+def test_groupby_aggregates(cluster):
+    """Distributed hash-partitioned groupby (reference:
+    grouped_data.py:36)."""
+    rows = [{"g": f"k{i % 5}", "x": float(i)} for i in range(100)]
+    ds = rtd.from_items(rows, num_blocks=8)
+
+    counts = {r["g"]: r["count()"] for r in ds.groupby("g").count().take_all()}
+    assert counts == {f"k{i}": 20 for i in range(5)}
+
+    sums = {r["g"]: r["sum(x)"] for r in ds.groupby("g").sum("x").take_all()}
+    assert sums["k0"] == sum(float(i) for i in range(0, 100, 5))
+
+    means = {r["g"]: r["mean(x)"]
+             for r in ds.groupby("g").mean("x").take_all()}
+    assert abs(means["k1"] - (sum(range(1, 100, 5)) / 20)) < 1e-9
+
+    multi = ds.groupby("g").aggregate(("min", "x"), ("max", "x")).take_all()
+    m = {r["g"]: (r["min(x)"], r["max(x)"]) for r in multi}
+    assert m["k2"] == (2.0, 97.0)
+
+
+def test_groupby_map_groups(cluster):
+    rows = [{"g": i % 3, "x": i} for i in range(30)]
+    ds = rtd.from_items(rows, num_blocks=5)
+
+    def summarize(group_rows):
+        g = group_rows[0]["g"]
+        return [{"g": g, "n": len(group_rows),
+                 "total": sum(r["x"] for r in group_rows)}]
+
+    out = {r["g"]: (r["n"], r["total"])
+           for r in ds.groupby("g").map_groups(summarize).take_all()}
+    assert out[0] == (10, sum(range(0, 30, 3)))
+    assert out[1] == (10, sum(range(1, 30, 3)))
+
+
+def test_logical_plan_rewrite(cluster):
+    """The planner seam: logical ops fuse via the rewrite rule and
+    explain() shows both plans (reference: rules/operator_fusion.py)."""
+    from ray_tpu.data import logical
+
+    ds = rtd.range(10, num_blocks=2).map(lambda r: r).filter(
+        lambda r: True).flat_map(lambda r: [r])
+    assert len(ds._logical) == 3
+    optimized = logical.optimize(ds._logical)
+    assert len(optimized) == 1 and optimized[0].name == "fused_map"
+    assert len(optimized[0].payload) == 3  # one task runs all three
+    plan = ds.explain()
+    assert "logical:" in plan and "Fused[" in plan
