@@ -202,6 +202,11 @@ class HeadService(RpcHost):
         # gcs_placement_group_manager.cc SchedulePendingPlacementGroups,
         # fired on resource-change events from the syncer)
         self._pg_wake_waiters: List[asyncio.Future] = []
+        # dashboard sparkline ring: 2s samples, ~5 minutes of history
+        from collections import deque as _deque
+
+        self._dash_series = _deque(maxlen=150)
+        self._dash_task: Optional[asyncio.Task] = None
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -228,6 +233,8 @@ class HeadService(RpcHost):
             self._health_task.cancel()
         if self._persist_task:
             self._persist_task.cancel()
+        if self._dash_task:
+            self._dash_task.cancel()
         if self._state_path and self._dirty:
             self._save_state()
         for n in self.nodes.values():
@@ -1190,11 +1197,21 @@ class HeadService(RpcHost):
 
         default_registry.add_collector(collect)
         try:
+            from ray_tpu._private import dashboard as _dash
+
             self._metrics_server, self.metrics_port = \
                 await start_metrics_http_server(
                     default_registry, host,
-                    extra_routes={"/": self._render_dashboard,
-                                  "/api/state": self._render_state_json})
+                    extra_routes={
+                        "/": lambda: ("text/html",
+                                      _dash.APP_HTML.encode()),
+                        "/app.js": lambda: ("application/javascript",
+                                            _dash.APP_JS.encode()),
+                        "/api/state": self._render_state_json,
+                        "/api/snapshot": self._render_snapshot_json,
+                        "/api/timeline": self._render_timeline_json,
+                    })
+            self._dash_task = asyncio.ensure_future(self._dash_sample_loop())
         except Exception:
             self.metrics_port = 0  # observability must never block boot
 
@@ -1216,68 +1233,99 @@ class HeadService(RpcHost):
         return "application/json", _json.dumps(self._state_snapshot(),
                                                default=str).encode()
 
-    def _render_dashboard(self):
-        """One-page cluster overview on the head's metrics port
-        (reference: dashboard/ — a full web app; here a dependency-free
-        snapshot: nodes, resources, actors, links to /metrics)."""
-        import html as _html
+    # ---- dashboard SPA data plane (reference: dashboard/ API routes
+    # consumed by the React client; here /api/snapshot feeds the
+    # single-file app in _private/dashboard.py) ---------------------------
 
-        s = self._state_snapshot()
-        rows = []
-        for n in s["nodes"]:
-            res = n["resources"]
-            avail, total = res.get("available", {}), res.get("total", {})
-            pretty = ", ".join(
-                f"{_html.escape(k)}: {avail.get(k, 0):g}/{v:g}"
-                for k, v in sorted(total.items()) if not k.startswith("node:"))
-            # labels/addrs are user-supplied strings: escape or a node
-            # registered with a <script> label XSSes the operator
-            rows.append(
-                f"<tr><td><code>{_html.escape(n['node_id'][:12])}</code></td>"
-                f"<td>{_html.escape(str(n['addr'][0]))}:{n['addr'][1]}</td>"
-                f"<td>{'head' if n.get('is_head_node') else 'worker'}</td>"
-                f"<td>{pretty}</td>"
-                f"<td>{_html.escape(str(n.get('labels') or ''))}</td></tr>")
-        actors = " ".join(f"{k}: {v}" for k, v in
-                          sorted(s["actors_by_state"].items())) or "none"
-        actor_rows = []
-        for a in list(self.actors.values())[:50]:
-            actor_rows.append(
-                f"<tr><td><code>{_html.escape(a.actor_id[:12])}</code></td>"
-                f"<td>{_html.escape(a.name or '')}</td>"
-                f"<td>{_html.escape(str(a.state))}</td>"
-                f"<td><code>{_html.escape((a.node_id or '')[:12])}</code></td>"
-                f"<td>{a.restarts_left}</td></tr>")
+    def _cpu_totals(self) -> Tuple[float, float]:
+        avail = total = 0.0
+        for n in self.nodes.values():
+            total += n.resources.total.get("CPU")
+            avail += n.resources.available.get("CPU")
+        return avail, total
+
+    def _tasks_finished_total(self) -> int:
+        return sum(1 for r in self.task_events.values()
+                   if r.get("state") in ("FINISHED", "FAILED"))
+
+    async def _dash_sample_loop(self):
+        """Every 2s append one sample to the sparkline ring (~5 min)."""
+        last_finished = self._tasks_finished_total()
+        while True:
+            await asyncio.sleep(2.0)
+            try:
+                avail, total = self._cpu_totals()
+                finished = self._tasks_finished_total()
+                self._dash_series.append({
+                    "ts": time.time(),
+                    "nodes": len(self.nodes),
+                    "cpus_avail": avail,
+                    "actors_alive": sum(1 for a in self.actors.values()
+                                        if a.state == ALIVE),
+                    # events roll off the capped store, so the delta can
+                    # dip negative on truncation — clamp
+                    "task_rate": max(0, finished - last_finished),
+                })
+                last_finished = finished
+            except Exception:
+                pass
+
+    def _render_snapshot_json(self):
+        import json as _json
+
         recent = sorted(self.task_events.values(),
                         key=lambda r: r.get("running_ts")
-                        or r.get("submitted_ts") or 0, reverse=True)[:30]
-        task_rows = []
-        for r in recent:
-            task_rows.append(
-                f"<tr><td><code>{_html.escape(r.get('task_id', '')[:12])}"
-                f"</code></td><td>{_html.escape(str(r.get('name', '')))}</td>"
-                f"<td>{_html.escape(str(r.get('state', '')))}</td>"
-                f"<td>{_html.escape(str(r.get('error', '') or '')[:80])}"
-                f"</td></tr>")
-        html = f"""<!doctype html><html><head><title>ray_tpu</title>
-<style>body{{font-family:sans-serif;margin:2em}}table{{border-collapse:collapse;
-margin-bottom:1.5em}}td,th{{border:1px solid #ccc;padding:4px 10px;
-text-align:left}}</style></head>
-<body><h1>ray_tpu cluster</h1>
-<p>{len(s['nodes'])} node(s) &middot; actors: {actors} &middot;
-{s['num_placement_groups']} placement group(s) &middot;
-<a href="/metrics">/metrics</a> &middot; <a href="/api/state">/api/state</a></p>
-<h2>Nodes</h2>
-<table><tr><th>node</th><th>address</th><th>role</th>
-<th>resources (avail/total)</th><th>labels</th></tr>
-{''.join(rows)}</table>
-<h2>Actors ({len(self.actors)})</h2>
-<table><tr><th>id</th><th>name</th><th>state</th><th>node</th>
-<th>restarts left</th></tr>{''.join(actor_rows)}</table>
-<h2>Recent tasks ({len(self.task_events)} tracked)</h2>
-<table><tr><th>id</th><th>name</th><th>state</th><th>error</th></tr>
-{''.join(task_rows)}</table></body></html>"""
-        return "text/html", html.encode()
+                        or r.get("submitted_ts") or 0, reverse=True)[:200]
+        jobs = []
+        try:
+            idx = self.kv.get("job:index")
+            for job_id in _json.loads(idx) if idx else []:
+                raw = self.kv.get(f"job:{job_id}:status")
+                if raw:
+                    jobs.append(_json.loads(raw))
+        except Exception:
+            pass
+        avail, total = self._cpu_totals()
+        snap = {
+            "nodes": [n.table_entry() for n in self.nodes.values()],
+            "actors": [a.info() for a in self.actors.values()],
+            "tasks": recent,
+            "placement_groups": [p.info(self.nodes)
+                                 for p in self.placement_groups.values()],
+            "jobs": jobs,
+            "series": list(self._dash_series),
+            "summary": {
+                "cpus_avail": round(avail, 2), "cpus_total": round(total, 2),
+                "actors_alive": sum(1 for a in self.actors.values()
+                                    if a.state == ALIVE),
+                "task_rate": (self._dash_series[-1]["task_rate"]
+                              if self._dash_series else 0),
+            },
+        }
+        return "application/json", _json.dumps(snap, default=str).encode()
+
+    def _render_timeline_json(self):
+        """Chrome-trace events straight off the task-event store (same
+        shape as util.state.timeline / `rtpu timeline`)."""
+        import json as _json
+
+        events = []
+        for t in self.task_events.values():
+            start = t.get("running_ts")
+            end = t.get("finished_ts") or t.get("failed_ts")
+            if start is None or end is None:
+                continue
+            events.append({
+                "name": t.get("name", t.get("task_id", "")[:8]),
+                "cat": t.get("kind", "task"), "ph": "X",
+                "ts": int(start * 1e6),
+                "dur": max(1, int((end - start) * 1e6)),
+                "pid": t.get("node_id", "")[:8],
+                "tid": t.get("worker_id", "")[:8],
+                "args": {"task_id": t.get("task_id"),
+                         "state": t.get("state")},
+            })
+        return "application/json", _json.dumps(events).encode()
 
     async def rpc_task_events(self, events: List[Dict[str, Any]]):
         """Workers flush task state transitions here in batches

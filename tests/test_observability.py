@@ -173,9 +173,10 @@ def test_list_objects(cluster):
     del ref
 
 
-def test_head_dashboard_page(local_cluster):
-    """The head's metrics port serves a one-page dashboard + state JSON
-    (reference: dashboard/)."""
+def test_head_dashboard_spa(local_cluster):
+    """The head serves the single-page dashboard app and its JSON data
+    plane, and the snapshot reflects live cluster state (reference:
+    dashboard/client/src — the role, not the framework)."""
     import json
     import urllib.request
 
@@ -183,11 +184,52 @@ def test_head_dashboard_page(local_cluster):
 
     port = rt.api._worker().head.call("metrics_port")["port"]
     assert port
-    with urllib.request.urlopen(f"http://127.0.0.1:{port}/", timeout=10) as r:
-        html = r.read().decode()
-    assert "ray_tpu cluster" in html and "resources" in html
-    with urllib.request.urlopen(f"http://127.0.0.1:{port}/api/state",
-                                timeout=10) as r:
-        state = json.loads(r.read())
-    assert len(state["nodes"]) == 1
-    assert "actors_by_state" in state
+
+    def fetch(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.headers.get("Content-Type", ""), r.read()
+
+    # app shell + the one JS file
+    ct, html = fetch("/")
+    assert ct.startswith("text/html")
+    assert "ray_tpu cluster" in html.decode()
+    assert '<script src="/app.js">' in html.decode()
+    ct, js = fetch("/app.js")
+    assert ct.startswith("application/javascript")
+    for needle in ("api/snapshot", "sparkline", "Placement groups"):
+        assert needle in js.decode()
+
+    # live state lands in the snapshot the app renders from
+    @rt.remote
+    def probe():
+        return 1
+
+    assert rt.get(probe.remote(), timeout=60) == 1
+
+    @rt.remote
+    class DashActor:
+        def ping(self):
+            return "pong"
+
+    a = DashActor.remote()
+    assert rt.get(a.ping.remote(), timeout=60) == "pong"
+
+    snap = json.loads(fetch("/api/snapshot")[1])
+    for key in ("nodes", "actors", "tasks", "placement_groups", "jobs",
+                "series", "summary"):
+        assert key in snap, key
+    assert len(snap["nodes"]) == 1
+    assert any(x["state"] == "ALIVE" for x in snap["actors"])
+    assert any(t.get("state") == "FINISHED" for t in snap["tasks"])
+    assert snap["summary"]["cpus_total"] > 0
+
+    # timeline download is a Chrome trace event list
+    events = json.loads(fetch("/api/timeline")[1])
+    assert isinstance(events, list) and events
+    assert all(e["ph"] == "X" and "ts" in e and "dur" in e for e in events)
+
+    # legacy summary endpoint unchanged
+    state = json.loads(fetch("/api/state")[1])
+    assert len(state["nodes"]) == 1 and "actors_by_state" in state
+    rt.kill(a)
