@@ -69,6 +69,69 @@ def bench_actor(ray_tpu, n_sync=300, n_async=2000):
     ray_tpu.get([a.m.remote() for _ in range(n_async)], timeout=120)
     return sync, n_async / (time.perf_counter() - t0)
 
+def bench_burst_then_async(ray_tpu, burst=2000, n=2000):
+    """Burst-independence phase (round-5 verdict top finding): 2000
+    BLOCKING sync round trips used to train the owner's per-function
+    service-time estimator into serializing dispatch, collapsing the
+    async rate that follows from ~5k/s to ~1.5k/s.  With depth driven by
+    worker-reported execution time this rate must track
+    tasks_async_per_s (the fresh-process async run) within noise."""
+    @ray_tpu.remote
+    def e():
+        return b"ok"
+
+    ray_tpu.get(e.remote(), timeout=60)
+    for _ in range(burst):
+        ray_tpu.get(e.remote(), timeout=60)
+    t0 = time.perf_counter()
+    ray_tpu.get([e.remote() for _ in range(n)], timeout=120)
+    return n / (time.perf_counter() - t0)
+
+def _client_bench(address: str, n: int):
+    """One concurrent driver (runs as a subprocess): connect to the
+    shared cluster, fire n async tasks, print one parseable line."""
+    import ray_tpu
+
+    ray_tpu.init(address=address)
+
+    @ray_tpu.remote
+    def e():
+        return b"ok"
+
+    ray_tpu.get([e.remote() for _ in range(50)], timeout=60)
+    t0 = time.perf_counter()
+    ray_tpu.get([e.remote() for _ in range(n)], timeout=120)
+    dt = time.perf_counter() - t0
+    print("CLIENTJSON " + json.dumps({"tasks": n, "wall_s": round(dt, 4)}))
+    ray_tpu.shutdown()
+
+def bench_multi_client(ray_tpu, clients=3, n=1000):
+    """Aggregate throughput with several concurrent DRIVER processes
+    sharing one cluster — the owners contend for the same agents'
+    leases, which is where history-dependent dispatch and greedy lease
+    retention show up as cross-client interference."""
+    addr = "%s:%d" % tuple(ray_tpu.api._worker().head_addr)
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--client-bench",
+         addr, str(n)], stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True, cwd=REPO)
+        for _ in range(clients)]
+    total = 0
+    t0 = time.perf_counter()
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            continue
+        for line in out.splitlines():
+            if line.startswith("CLIENTJSON "):
+                total += json.loads(line[len("CLIENTJSON "):])["tasks"]
+    wall = time.perf_counter() - t0
+    if total == 0:
+        raise RuntimeError("no concurrent client completed")
+    return total / wall
+
 def bench_small_ops(ray_tpu, n=1000):
     """Small-object put/get ops/s (reference: ray_perf.py:120-122,
     'single client get/put' — 10,181.6 / 5,545.0 ops/s recorded)."""
@@ -223,6 +286,14 @@ def main():
             "pg_create_remove_per_s", round(bench_pg_churn(ray_tpu), 1)))
         phase("put", lambda: extras.__setitem__(
             "put_gb_per_s", round(bench_put_gbps(ray_tpu), 2)))
+        # burst-sequence + multi-client phases LAST among task phases:
+        # the sync burst is deliberate history pollution, and proving the
+        # earlier numbers unaffected by ordering is part of the contract
+        phase("burst_async", lambda: extras.__setitem__(
+            "burst_async_per_s", round(bench_burst_then_async(ray_tpu), 1)))
+        phase("multi_client", lambda: extras.__setitem__(
+            "multi_client_tasks_per_s",
+            round(bench_multi_client(ray_tpu), 1)))
         try:
             ray_tpu.shutdown()
         except Exception as exc:  # noqa: BLE001
@@ -244,5 +315,9 @@ def main():
 if __name__ == "__main__":
     if "--train-bench" in sys.argv:
         _train_bench_loop(force_cpu="--cpu" in sys.argv)
+    elif "--client-bench" in sys.argv:
+        sys.path.insert(0, REPO)
+        i = sys.argv.index("--client-bench")
+        _client_bench(sys.argv[i + 1], int(sys.argv[i + 2]))
     else:
         main()

@@ -898,7 +898,16 @@ class HeadService(RpcHost):
         entry = _PgEntry(pg_id, bundles, strategy, name)
         self.placement_groups[pg_id] = entry
         self.mark_dirty()
-        asyncio.ensure_future(self._schedule_pg(entry))
+        # one inline scheduling pass before replying: for the common
+        # create-then-ready pattern the follow-up get_placement_group
+        # then answers CREATED immediately with no waiter park/wake
+        # cycle (PG churn is a benchmarked hot path); a group that
+        # doesn't fit right now falls back to the event-driven loop.
+        # inline=True: this pass must not block the create reply behind
+        # a reservation queue wait on a saturated cluster
+        await self._schedule_pg(entry, max_attempts=1, inline=True)
+        if entry.state == PG_PENDING:
+            asyncio.ensure_future(self._schedule_pg(entry))
         return {"pg_id": pg_id}
 
     async def rpc_get_placement_group(self, pg_id: str, wait: bool = False,
@@ -1032,23 +1041,30 @@ class HeadService(RpcHost):
             if fut in self._pg_wake_waiters:
                 self._pg_wake_waiters.remove(fut)
 
-    async def _schedule_pg(self, entry: _PgEntry):
+    async def _schedule_pg(self, entry: _PgEntry, max_attempts: int = 0,
+                           inline: bool = False):
         """Keep trying until reserved or removed.  Like the reference, a
         group that doesn't currently fit stays PENDING indefinitely (the
         autoscaler is what resolves persistent infeasibility).
 
         Retries are event-driven: a failed attempt parks on
         _wait_pg_event and is woken by heartbeats/bundle returns/node
-        registrations, with sleep backoff only as the fallback."""
+        registrations, with sleep backoff only as the fallback.
+        ``max_attempts`` > 0 bounds the passes; ``inline`` marks the
+        fast path inside create, which must never block the reply — it
+        reserves with no queue wait and leaves the one-shot optimistic
+        full-wait budget to the event-driven loop."""
         delay = 0.05
+        attempts = 0
         while entry.state == PG_PENDING \
                 and self.placement_groups.get(entry.pg_id) is entry:
+            attempts += 1
             plan = self._plan_pg(entry)
             # an availability-backed plan always reserves with a wait:
             # the view can be stale the other way (shows available, node
             # briefly isn't — lingering leases), and a queued reservation
             # grants the moment the agent reclaims them
-            wait_ms = int(config.pg_reserve_wait_ms)
+            wait_ms = 0 if inline else int(config.pg_reserve_wait_ms)
             if plan is None:
                 # the availability view may simply be stale (lingering
                 # leases just returned, heartbeat not in yet): target
@@ -1057,7 +1073,7 @@ class HeadService(RpcHost):
                 # occupied capacity, so only the FIRST such attempt may
                 # block the node's lease queue for the full wait
                 plan = self._plan_pg(entry, optimistic=True)
-                if entry.opt_wait_used:
+                if inline or entry.opt_wait_used:
                     wait_ms = 0
                 elif plan is not None:
                     entry.opt_wait_used = True
@@ -1098,6 +1114,8 @@ class HeadService(RpcHost):
                     self.mark_dirty()
                     entry.wake()
                     return
+            if max_attempts and attempts >= max_attempts:
+                return
             woke = await self._wait_pg_event(delay)
             delay = 0.05 if woke else min(delay * 2, 1.0)
 

@@ -158,6 +158,7 @@ class NodeAgent(RpcHost):
         # on resource-change notifications from the syncer)
         self._hb_wake = asyncio.Event()
         self._last_reclaim = 0.0  # rate limit for _reclaim_idle_leases
+        self._reclaim_followup = False  # trailing-edge push scheduled
         # queued lease requests by client request id, so owners can
         # cancel requests whose demand drained before a grant
         # (reference: node_manager.proto CancelWorkerLease)
@@ -652,9 +653,10 @@ class NodeAgent(RpcHost):
 
         With ``wait_ms`` > 0 a reservation that cannot be satisfied right
         now joins the FIFO lease queue instead of failing: the moment a
-        lingering task lease returns (worker.py _LEASE_LINGER_S) the
-        freed capacity grants the reservation — placement groups preempt
-        the linger cache event-driven rather than the head polling."""
+        warm-pooled task lease returns (worker.py _WARM_LEASE_TTL_S, or
+        sooner via the demand-aware reclaim push) the freed capacity
+        grants the reservation — placement groups preempt the warm pool
+        event-driven rather than the head polling."""
         key = f"{pg_id}:{bundle_index}"
         if key in self._bundles:
             return {"ok": True, "already": True}
@@ -896,22 +898,54 @@ class NodeAgent(RpcHost):
 
     def _reclaim_idle_leases(self) -> None:
         """Demand just queued behind granted leases: ask every lease's
-        owner to hand back leases with nothing in flight RIGHT NOW
-        instead of letting them sit out the owner-side linger window
-        (worker.py _LEASE_LINGER_S).  Best-effort oneway pushes; an owner
-        that just assigned a task simply ignores the request.  This is
-        what keeps placement-group reservation latency flat right after
-        a task burst (reference: the raylet revoking unused workers via
-        ReleaseUnusedWorkers when demand arrives)."""
+        owner to hand back warm-pooled leases RIGHT NOW instead of
+        letting them sit out the owner-side warm-lease TTL (worker.py
+        _WARM_LEASE_TTL_S).  The push carries the aggregate queued
+        demand so owners return only enough capacity to cover it and
+        keep the rest of their pool warm.  Best-effort oneway pushes; an
+        owner that just assigned a task simply ignores the request.
+        This is what keeps placement-group reservation latency flat
+        right after a task burst (reference: the raylet revoking unused
+        workers via ReleaseUnusedWorkers when demand arrives)."""
         now = time.monotonic()
         if now - self._last_reclaim < 0.05:  # coalesce bursts of queuers
+            # trailing edge: a waiter that queued just after the last
+            # push still gets its demand to owners once the window ends
+            # (the need snapshot below is recomputed at fire time), so
+            # owners' need-bounded covered() check can't strand it until
+            # the warm-lease TTL sweep
+            if not self._reclaim_followup:
+                self._reclaim_followup = True
+
+                def _fire():
+                    self._reclaim_followup = False
+                    # only node-pool waiters count — they are what the
+                    # need snapshot aggregates; a push for a purely
+                    # bundle-internal queue would carry need={}, which
+                    # owners read as unbounded and answer by evicting
+                    # their whole warm pool
+                    if any(sched is self.local for _, _, sched
+                           in self._lease_waiters.values()):
+                        self._reclaim_idle_leases()
+
+                asyncio.get_running_loop().call_later(
+                    0.05 - (now - self._last_reclaim), _fire)
             return
         self._last_reclaim = now
         conns = {id(l.owner_conn): l.owner_conn
                  for l in self._leases.values()
                  if l.owner_conn is not None}
 
-        payload = {"agent": [self.host, self.port]}
+        # aggregate node-pool demand currently queued (bundle-internal
+        # queues resolve within their bundle and are excluded)
+        need: Dict[str, float] = {}
+        for fut, demand, sched in self._lease_waiters.values():
+            if sched is not self.local:
+                continue
+            for k, v in demand.to_dict().items():
+                need[k] = need.get(k, 0.0) + v
+
+        payload = {"agent": [self.host, self.port], "need": need}
 
         async def _push(conn):
             try:

@@ -29,6 +29,7 @@ from __future__ import annotations
 import asyncio
 import mmap
 import os
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
@@ -465,10 +466,21 @@ class _SharedRelease:
             fn()
 
 
+# Buffer's __buffer__ hook (PEP 688) only reaches the C buffer protocol
+# on Python 3.12+; earlier interpreters see a plain object and every
+# out-of-band consumer (numpy, pyarrow) rejects it with "a bytes-like
+# object is required"
+_PEP688 = sys.version_info >= (3, 12)
+
+
 def deserialize_pinned(data: memoryview, on_release: Optional[Callable[[], None]]) -> Any:
     """Zero-copy deserialize; the pin is released when the value (all of its
     out-of-band-backed parts) is garbage collected, or immediately if the
-    value embeds no out-of-band buffers."""
+    value embeds no out-of-band buffers.
+
+    On interpreters without PEP 688 the out-of-band frames are copied
+    instead (one memcpy per frame) and the pin releases immediately —
+    correctness over zero-copy; the wrapper path resumes on 3.12+."""
     from ray_tpu._private import serialization
 
     frames = serialization.unpack_frames(data)
@@ -481,6 +493,12 @@ def deserialize_pinned(data: memoryview, on_release: Optional[Callable[[], None]
         return value
     import pickle
 
+    if not _PEP688:
+        try:
+            return pickle.loads(frames[0],
+                                buffers=[f.tobytes() for f in frames[1:]])
+        finally:
+            on_release()
     shared = _SharedRelease(len(frames) - 1, on_release)
     buffers = [Buffer(f, shared) for f in frames[1:]]
     return pickle.loads(frames[0], buffers=buffers)

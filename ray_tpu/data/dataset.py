@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import builtins
 import random as _random
+import time
 from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
                     Tuple, Union)
 
@@ -21,43 +22,115 @@ import numpy as np
 from ray_tpu.data import logical
 from ray_tpu.data.block import BlockAccessor, block_from_numpy, build_block
 
-# streaming window bounds (resource-aware; see _stream_window)
+# streaming backpressure bounds (count clamps around the bytes budget;
+# see _StreamBudget)
 _WINDOW_MIN = 2
 _WINDOW_MAX = 64
-_window_cache = [0.0, 8]  # (expires_at, value)
+_BUDGET_FRACTION = 0.25        # of free store capacity per iteration
+_BUDGET_FALLBACK = 64 * 1024 * 1024   # store stats unavailable
+_BLOCK_EST_INIT = 1 * 1024 * 1024     # until real block sizes arrive
+_OCCUPANCY_REFRESH_S = 0.5
 
 
-def _stream_window() -> int:
-    """Concurrent in-flight block-tasks during iteration, derived from
-    live cluster state instead of a fixed constant (reference:
-    _internal/execution/streaming_executor.py + backpressure_policy/ —
-    the reference sizes concurrency from resource budgets and pauses on
-    object-store pressure).
-
-    Window = 2 tasks per available CPU, halved when the local
-    object store is above 80% occupancy; clamped to [2, 64].
-    """
-    import time as _time
-
+def _store_usage() -> Tuple[int, int]:
+    """(allocated, capacity) of the local object store, best-effort.
+    Module-level so tests can monkeypatch the probe."""
     import ray_tpu
 
-    now = _time.monotonic()
-    if now < _window_cache[0]:
-        return _window_cache[1]
-    window = 8
+    usage = ray_tpu.api._worker().agent.call("node_info", timeout=2.0)["store"]
+    return int(usage["allocated"]), int(usage["capacity"])
+
+
+# one process-wide occupancy snapshot, refreshed at most every
+# _OCCUPANCY_REFRESH_S: budgets stay per-execution, but the blocking
+# node_info RPC behind them is amortized across all live iterators (a
+# driver loop calling take(1)/schema() repeatedly must not pay a
+# synchronous RPC — up to the 2s timeout against a wedged agent — per
+# iteration start).  Failures are cached for the same window.
+_usage_snapshot: Tuple[float, Optional[Tuple[int, int]]] = (0.0, None)
+
+
+def _store_usage_cached() -> Tuple[int, int]:
+    global _usage_snapshot
+    now = time.monotonic()
+    ts, val = _usage_snapshot
+    if ts and now - ts < _OCCUPANCY_REFRESH_S:
+        if val is None:
+            raise RuntimeError("store stats unavailable (cached failure)")
+        return val
     try:
-        cpus = ray_tpu.cluster_resources().get("CPU", 4.0)
-        window = int(cpus * 2)
-        usage = ray_tpu.api._worker().agent.call(
-            "node_info", timeout=2.0)["store"]
-        if usage["capacity"] and usage["allocated"] / usage["capacity"] > 0.8:
-            window //= 2  # store pressure: stop outrunning consumption
+        val = _store_usage()
     except Exception:
-        pass
-    window = max(_WINDOW_MIN, min(_WINDOW_MAX, window))
-    _window_cache[0] = now + 0.5
-    _window_cache[1] = window
-    return window
+        _usage_snapshot = (now, None)
+        raise
+    _usage_snapshot = (now, val)
+    return val
+
+
+class _StreamBudget:
+    """Per-EXECUTION streaming backpressure (reference:
+    _internal/execution/streaming_executor.py + backpressure_policy/ —
+    the reference bounds each execution by a resource budget and pauses
+    on object-store pressure).
+
+    Every ``iter_blocks()`` call constructs its own instance, so two
+    concurrent iterations each get an independent budget instead of
+    sharing one process-global window (the former 2-entry
+    ``_stream_window`` cache meant iterator A's refresh dictated
+    iterator B's concurrency).  The budget is in BYTES: a quarter of the
+    store capacity that was free when the iteration began, spent against
+    a running per-block size estimate (EWMA of consumed blocks), with
+    [_WINDOW_MIN, _WINDOW_MAX] count clamps so tiny blocks still bound
+    task fan-out and huge blocks still make progress.  Store occupancy
+    is re-probed every _OCCUPANCY_REFRESH_S per instance; above 80% the
+    effective budget halves.
+    """
+
+    __slots__ = ("budget_bytes", "inflight", "est_bytes", "_pressure",
+                 "_probe_at")
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        if budget_bytes is None:
+            try:
+                allocated, capacity = _store_usage_cached()
+                free = max(0, capacity - allocated)
+                budget_bytes = int(free * _BUDGET_FRACTION) \
+                    or _BUDGET_FALLBACK
+            except Exception:
+                budget_bytes = _BUDGET_FALLBACK
+        self.budget_bytes = budget_bytes
+        self.inflight = 0              # launched - consumed block tasks
+        self.est_bytes = float(_BLOCK_EST_INIT)
+        self._pressure = False
+        self._probe_at = 0.0
+
+    def _effective(self) -> float:
+        now = time.monotonic()
+        if now >= self._probe_at:
+            self._probe_at = now + _OCCUPANCY_REFRESH_S
+            try:
+                allocated, capacity = _store_usage_cached()
+                self._pressure = bool(capacity) \
+                    and allocated / capacity > 0.8
+            except Exception:
+                self._pressure = False
+        return self.budget_bytes / 2 if self._pressure else self.budget_bytes
+
+    def admit(self) -> bool:
+        """May one more block task launch right now?"""
+        if self.inflight < _WINDOW_MIN:
+            return True
+        if self.inflight >= _WINDOW_MAX:
+            return False
+        return (self.inflight + 1) * self.est_bytes <= self._effective()
+
+    def launched(self) -> None:
+        self.inflight += 1
+
+    def consumed(self, nbytes: int) -> None:
+        self.inflight -= 1
+        if nbytes > 0:
+            self.est_bytes = 0.5 * (self.est_bytes + float(nbytes))
 
 
 # --------------------------------------------------------------------- ops
@@ -214,20 +287,66 @@ def _stable_hash(value) -> int:
     return zlib.crc32(repr(value).encode("utf-8", "backslashreplace"))
 
 
+def _canon_key(value):
+    """Canonical form of a group key BEFORE it is hashed or bucketed.
+
+    Partitioning hashes the key's repr (_stable_hash), but Python
+    equality is looser than repr equality: ``2 == 2.0 == True+True`` yet
+    their reprs differ, so without canonicalization equal keys land in
+    different partitions and the same group emits one aggregate row per
+    partition it leaked into.  Numerics therefore normalize (bool → int,
+    integral float → int, numpy scalar → Python scalar) so that any two
+    keys equal under ``==`` share one canonical repr.
+
+    Supported key types: None, bool, int, float (non-NaN), str, bytes,
+    numpy scalars of those, and tuples/lists thereof (canonicalized
+    element-wise to a tuple, so ``('a', 2)`` and ``['a', 2.0]`` share a
+    group — Arrow stores sequence keys as list columns, so a tuple key
+    written into a block reads back as a list and must canonicalize to
+    the same value).  Anything else — dicts, NaN (which is not even
+    equal to itself), arbitrary objects — is rejected loudly rather
+    than silently mis-partitioned.
+    """
+    if isinstance(value, np.generic):
+        value = value.item()
+    if isinstance(value, bool):
+        return int(value)
+    if isinstance(value, float):
+        if value != value:
+            raise TypeError("NaN groupby keys are unsupported (NaN != NaN: "
+                            "no grouping is well-defined)")
+        return int(value) if value.is_integer() else value
+    if value is None or isinstance(value, (int, str, bytes)):
+        return value
+    if isinstance(value, (tuple, list)):
+        return tuple(_canon_key(v) for v in value)
+    raise TypeError(
+        f"unsupported groupby key type {type(value).__name__!r}: keys must "
+        "be None, bool, int, float, str, bytes, numpy scalars of those, "
+        "or tuples/lists thereof")
+
+
 def _groupby_map(block, key: str, n_out: int):
-    """Hash-partition one block's rows by group key."""
+    """Hash-partition one block's rows by CANONICAL group key.  The key
+    column is rewritten to its canonical value: equal-under-== keys now
+    share a partition, so without normalization one partition block
+    could mix bool and numeric key values, which Arrow refuses to
+    type-unify."""
     parts: List[List[dict]] = [[] for _ in builtins.range(n_out)]
     for r in BlockAccessor(block).to_rows():
-        parts[_stable_hash(r[key]) % n_out].append(r)
+        k = _canon_key(r[key])
+        parts[_stable_hash(k) % n_out].append({**r, key: k})
     out = tuple(build_block(p) for p in parts)
     return out if n_out > 1 else out[0]
 
 
 def _group_rows(key: str, parts) -> Dict[Any, List[dict]]:
+    """Bucket rows by canonical key; the canonical value is also what
+    the output row carries (2.0 and 2 grouped together report key 2)."""
     groups: Dict[Any, List[dict]] = {}
     for p in parts:
         for r in BlockAccessor(p).to_rows():
-            groups.setdefault(r[key], []).append(r)
+            groups.setdefault(_canon_key(r[key]), []).append(r)
     return groups
 
 
@@ -369,6 +488,10 @@ class Dataset:
 
     # ---- execution ----
 
+    def _make_budget(self) -> _StreamBudget:
+        """One fresh backpressure budget per iteration (test seam)."""
+        return _StreamBudget()
+
     def _submit_block(self, ref) -> Any:
         """Launch the fused op chain on one source block; returns a ref."""
         import ray_tpu
@@ -452,9 +575,10 @@ class Dataset:
     # ---- consumption ----
 
     def iter_blocks(self) -> Iterator[Any]:
-        """Stream result blocks with a bounded in-flight window sized
-        from live cluster resources and store occupancy
-        (reference: streaming executor backpressure)."""
+        """Stream result blocks under a per-execution bytes budget
+        derived from object-store occupancy (reference: streaming
+        executor backpressure).  Each call gets its OWN _StreamBudget —
+        concurrent iterations never share a window."""
         import time as _time
 
         import ray_tpu
@@ -495,50 +619,67 @@ class Dataset:
                         rr += 1
                     yield tally(ray_tpu.get(in_flight.pop(0), timeout=600))
                 return
+            budget = self._make_budget()
             if self._ops and len(pending) >= 4:
                 # enough work to amortize shard tasks: the generator-based
                 # executor replaces per-block task submission
-                yield from self._iter_blocks_stream_shards(pending, tally)
+                yield from self._iter_blocks_stream_shards(
+                    pending, tally, budget)
                 return
             while pending or in_flight:
-                while pending and len(in_flight) < _stream_window():
+                while pending and budget.admit():
                     in_flight.append(self._submit_block(pending.pop(0)))
+                    budget.launched()
                 ref = in_flight.pop(0)
-                yield tally(ray_tpu.get(ref, timeout=600))
+                block = ray_tpu.get(ref, timeout=600)
+                budget.consumed(getattr(block, "nbytes", 0))
+                yield tally(block)
         finally:
             finish()
 
-    def _iter_blocks_stream_shards(self, refs: List[Any], tally):
-        """Task-path executor rebuilt on streaming generators: k shard
+    def _iter_blocks_stream_shards(self, refs: List[Any], tally,
+                                   budget: _StreamBudget):
+        """Task-path executor rebuilt on streaming generators: shard
         tasks each pull their source blocks and YIELD each transformed
         block as it is produced, so consumption overlaps production
         without a driver-side in-flight window (reference: the streaming
         executor consuming generator outputs —
         data/_internal/execution/streaming_executor.py + the
-        generator-backed MapOperator).  Streaming tasks are not
-        auto-retried; a shard that dies mid-stream is resubmitted here
-        for only its unconsumed suffix."""
+        generator-backed MapOperator).
+
+        A launched shard's unconsumed yields buffer owner-side, so the
+        per-execution budget governs both the CHUNK size (about half the
+        blocks the budget covers, so lookahead has byte-granularity) and
+        whether a lookahead shard may launch at all; the whole chunk is
+        charged at launch and credited back block-by-block as
+        consumption drains it.  Streaming tasks are not auto-retried; a
+        shard that dies mid-stream is resubmitted here for only its
+        unconsumed suffix."""
         import ray_tpu
 
-        import ray_tpu
-
-        k = min(4, len(refs))
-        size = (len(refs) + k - 1) // k
-        chunks = [refs[i * size:(i + 1) * size] for i in builtins.range(k)]
-        chunks = [c for c in chunks if c]
+        budget_blocks = max(1, int(budget.budget_bytes
+                                   // max(budget.est_bytes, 1.0)))
+        size = max(1, min((len(refs) + 3) // 4,  # ≥4 shards when possible
+                          max(1, budget_blocks // 2)))
+        chunks = [refs[i:i + size]
+                  for i in builtins.range(0, len(refs), size)]
         fn = _remote_fused_stream()
-        # at most 2 shards producing ahead of consumption: unconsumed
-        # yields buffer owner-side, so eager-launching every shard would
-        # materialize most of the dataset before it is iterated
         gens: List[Any] = [None] * len(chunks)
-        def launch(i):
-            if i < len(chunks) and gens[i] is None:
+
+        def launch(i, force=False):
+            if i < len(chunks) and gens[i] is None \
+                    and (force or budget.admit()):
                 gens[i] = fn.remote(chunks[i], self._ops)
-        launch(0)
+                for _ in chunks[i]:
+                    budget.launched()
+
+        launch(0, force=True)  # progress even when budget < one chunk
         launch(1)
         for ci, chunk in enumerate(chunks):
             consumed = 0
             attempts = 3
+            if gens[ci] is None:
+                launch(ci, force=True)
             gen = gens[ci]
             while consumed < len(chunk):
                 try:
@@ -557,9 +698,12 @@ class Dataset:
                     continue
                 # deterministic op errors (RayTaskError) propagate —
                 # re-running the chain would just fail again
-                yield tally(ray_tpu.get(ref, timeout=600))
+                block = ray_tpu.get(ref, timeout=600)
+                budget.consumed(getattr(block, "nbytes", 0))
+                yield tally(block)
                 consumed += 1
-            launch(ci + 2)
+                launch(ci + 1)
+                launch(ci + 2)
 
     def iter_rows(self) -> Iterator[dict]:
         for block in self.iter_blocks():

@@ -642,18 +642,53 @@ class _SharedWaiter:
         self._lock = threading.Lock()
         self._items: Dict[str, Callable[[], None]] = {}  # oid -> cb
         self._refs: Dict[str, Any] = {}
+        # streaming calls: task_id -> (ObjectRefGenerator, cb); fired
+        # when the underlying generator TASK completes/errors, which is
+        # what keeps inflight accounting honest for streams the consumer
+        # abandons without ever iterating
+        self._gens: Dict[str, Any] = {}
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def _start_locked(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="serve-waiter", daemon=True)
+            self._thread.start()
 
     def watch(self, ref, cb: Callable[[], None]) -> None:
         with self._lock:
             self._items[ref.oid] = cb
             self._refs[ref.oid] = ref
-            if self._thread is None or not self._thread.is_alive():
-                self._thread = threading.Thread(
-                    target=self._run, name="serve-waiter", daemon=True)
-                self._thread.start()
+            self._start_locked()
         self._wake.set()
+
+    def watch_gen(self, gen, cb: Callable[[], None]) -> None:
+        """Fire ``cb`` once the streaming generator's replica-side task
+        has finished producing (completed OR errored) — independent of
+        whether any consumer ever iterates the stream."""
+        with self._lock:
+            self._gens[gen.task_id] = (gen, cb)
+            self._start_locked()
+        self._wake.set()
+
+    def _check_gens(self) -> None:
+        with self._lock:
+            gens = list(self._gens.items())
+        for tid, (gen, cb) in gens:
+            try:
+                done = gen.completed()
+            except Exception:
+                done = True  # runtime gone: release rather than leak
+            if not done:
+                continue
+            with self._lock:
+                if self._gens.pop(tid, None) is None:
+                    continue
+            try:
+                cb()
+            except Exception:
+                pass
 
     def _run(self):
         import ray_tpu
@@ -661,19 +696,22 @@ class _SharedWaiter:
         idle_rounds = 0
         err_rounds = 0
         while True:
+            self._check_gens()
             with self._lock:
                 refs = list(self._refs.values())
-                if not refs and idle_rounds >= 100:
+                if not refs and not self._gens and idle_rounds >= 100:
                     # retire under the lock so a concurrent watch() either
                     # sees a dead thread (and restarts one) or we see its ref
                     self._thread = None
                     return
+                busy = bool(refs or self._gens)
+            if busy:
+                idle_rounds = 0
             if not refs:
                 self._wake.wait(0.1)
                 self._wake.clear()
                 idle_rounds += 1
                 continue
-            idle_rounds = 0
             try:
                 ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.2)
                 err_rounds = 0
@@ -888,14 +926,30 @@ class DeploymentHandle:
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
         gen = replica.stream_request.options(
             num_returns="streaming").remote(_method, args, kwargs)
+        released = [False]
+
+        def _release(rid=rid):
+            # once-only: both the consumer finally and the waiter fire
+            with self._lock:
+                if released[0]:
+                    return
+                released[0] = True
+                if rid in self._inflight:
+                    self._inflight[rid] -= 1
+
+        # the consumer-side finally alone LEAKS: a generator that is
+        # never iterated never enters its try block, so an abandoned
+        # stream() call would pin +1 inflight on the replica forever and
+        # skew least-inflight selection.  The shared waiter decrements
+        # when the replica-side task finishes producing (or errors), no
+        # matter what the consumer does.
+        _shared_waiter.watch_gen(gen, _release)
 
         def _wrapped():
             try:
                 yield from gen
             finally:
-                with self._lock:
-                    if rid in self._inflight:
-                        self._inflight[rid] -= 1
+                _release()
 
         return _wrapped()
 

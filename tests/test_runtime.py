@@ -47,7 +47,8 @@ def test_task_runs_in_separate_process(cluster):
 
     @ray_tpu.remote
     def pid():
-        time.sleep(0.2)  # slow enough that one worker cannot drain the queue
+        time.sleep(0.5)  # slow enough that one worker cannot drain the
+        # queue even while fresh workers are still booting on a loaded host
         return os.getpid()
 
     pids = set(ray_tpu.get([pid.remote() for _ in range(8)], timeout=60))

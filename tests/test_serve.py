@@ -333,6 +333,37 @@ def test_handle_streaming(cluster):
     serve.delete("tokens")
 
 
+def test_stream_abandoned_releases_inflight(cluster):
+    """A stream() whose consumer never iterates (or stops early) must
+    still release its inflight count once the replica-side generator
+    finishes producing — the consumer-side finally alone never runs for
+    an un-iterated generator, and a leaked +1 would permanently skew
+    least-inflight replica selection."""
+    @serve.deployment(name="drops")
+    def drops(n):
+        for i in range(int(n)):
+            yield i
+
+    handle = serve.run(drops.bind())
+
+    def total_inflight():
+        with handle._lock:
+            return sum(handle._inflight.values())
+
+    # consumed stream: the consumer finally releases (and the waiter's
+    # release is once-only, so the count must not go negative)
+    out = [ray_tpu.get(r, timeout=30) for r in handle.stream(3)]
+    assert out == [0, 1, 2]
+    # abandoned streams: never iterated at all
+    for _ in range(3):
+        handle.stream(4)
+    deadline = time.time() + 15
+    while time.time() < deadline and total_inflight() != 0:
+        time.sleep(0.05)
+    assert total_inflight() == 0, handle._inflight
+    serve.delete("drops")
+
+
 def test_http_streaming_chunked(cluster):
     """Accept: text/event-stream gets a chunked response fed by the
     replica's generator, tokens arriving progressively (reference:
