@@ -63,6 +63,12 @@ _def("memory_monitor_test_usage_file", "")    # test hook: fraction in a file
 _def("task_events_buffer_size", 10_000)
 _def("metrics_report_interval_ms", 5_000)
 _def("event_stats", True)
+# --- distributed tracing (see _private/tracing.py) ---------------------------
+_def("tracing_enabled", True)
+_def("trace_sampling_ratio", 1.0)      # root-span sampling probability
+_def("trace_buffer_size", 4096)        # per-process finished-span buffer
+_def("trace_store_max_traces", 1000)   # head-side bounded trace store
+_def("trace_store_max_spans", 512)     # per-trace span cap at the head
 
 
 class _Config:

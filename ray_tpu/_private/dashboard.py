@@ -85,7 +85,7 @@ let TASK_FILTER = "";
 
 const TABS = [
   ["nodes", "Nodes"], ["actors", "Actors"], ["tasks", "Tasks"],
-  ["pgs", "Placement groups"], ["jobs", "Jobs"],
+  ["pgs", "Placement groups"], ["jobs", "Jobs"], ["traces", "Traces"],
 ];
 
 function el(tag, attrs, ...children) {
@@ -212,6 +212,16 @@ const VIEWS = {
       el("code", {}, j.job_id || ""), chip(j.status),
       j.entrypoint || "", (j.message || "").slice(0, 90),
     ])),
+  // recent traces off the head's trace store; the trace id links to
+  // the span dump at /api/traces/<id> (same data as `rtpu trace get`)
+  traces: s => table(
+    ["trace", "root span", "spans", "duration"],
+    (s.traces || []).map(t => [
+      el("a", {href: "/api/traces/" + t.trace_id},
+         el("code", {}, (t.trace_id || "").slice(0, 16))),
+      t.root || "", t.num_spans,
+      (t.duration_s * 1000).toFixed(1) + " ms",
+    ])),
 };
 
 function render() {
@@ -231,7 +241,7 @@ function render() {
   tabs.replaceChildren(...TABS.map(([id, label]) => {
     const counts = {nodes: s.nodes.length, actors: s.actors.length,
                     tasks: s.tasks.length, pgs: s.placement_groups.length,
-                    jobs: s.jobs.length};
+                    jobs: s.jobs.length, traces: (s.traces || []).length};
     const b = el("button", {class: id === TAB ? "active" : "",
                             onclick: () => { TAB = id; render(); }},
                  `${label} (${counts[id]})`);

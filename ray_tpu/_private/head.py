@@ -193,6 +193,16 @@ class HeadService(RpcHost):
         # task-event store: merged record per task, insertion-ordered so
         # the oldest fall off at the cap (reference: gcs_task_manager.h)
         self.task_events: Dict[str, Dict[str, Any]] = {}
+        # trace store: trace_id -> {spans, start, end, root}, insertion-
+        # ordered and bounded like the task-event store (see tracing.py)
+        self.traces: Dict[str, Dict[str, Any]] = {}
+        self._trace_spans_dropped = 0
+        # task_id -> set of scheduler-latency phases already observed
+        # into the histogram (each phase observed once per task; phases
+        # complete incrementally because owner and executor flush their
+        # halves of the timestamps on independent clocks)
+        self._sched_observed: Dict[str, set] = {}
+        self._sched_hist = None  # created in _start_metrics
         self._metrics_server = None
         self.metrics_port = 0
         # pending-PG replan wakeups: futures resolved whenever cluster
@@ -1189,13 +1199,24 @@ class HeadService(RpcHost):
     async def _start_metrics(self, host: str) -> None:
         """Prometheus endpoint with control-plane gauges
         (reference: stats/metric_defs.cc via the reporter agent)."""
-        from ray_tpu._private.metrics import (Gauge, default_registry,
+        from ray_tpu._private.metrics import (Gauge, Histogram,
+                                              default_registry,
                                               start_metrics_http_server)
 
         nodes_g = Gauge("rt_head_nodes", "live nodes in the cluster")
         actors_g = Gauge("rt_head_actors", "actors by state")
         pgs_g = Gauge("rt_head_placement_groups", "placement groups by state")
         tasks_g = Gauge("rt_head_task_events", "task event records held")
+        traces_g = Gauge("rt_head_traces", "traces held in the trace store")
+        # per-phase task latency derived from the task-event timestamps:
+        # queued (submitted→leased), leased (leased→running, i.e. the
+        # push/dispatch leg), running (running→finished) — the breakdown
+        # the MPMD-pipeline papers need for diagnosing stage stalls
+        self._sched_hist = Histogram(
+            "ray_tpu_task_sched_latency_seconds",
+            "task scheduling latency by phase",
+            boundaries=[0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1,
+                        5, 30])
 
         def collect():
             nodes_g.set(len(self.nodes))
@@ -1212,6 +1233,7 @@ class HeadService(RpcHost):
             for s, n in pstates.items():
                 pgs_g.set(n, tags={"state": s})
             tasks_g.set(len(self.task_events))
+            traces_g.set(len(self.traces))
 
         default_registry.add_collector(collect)
         try:
@@ -1228,6 +1250,10 @@ class HeadService(RpcHost):
                         "/api/state": self._render_state_json,
                         "/api/snapshot": self._render_snapshot_json,
                         "/api/timeline": self._render_timeline_json,
+                        "/api/traces": self._render_traces_json,
+                        # trailing slash = prefix route: the suffix is
+                        # passed in (/api/traces/<trace_id>)
+                        "/api/traces/": self._render_one_trace_json,
                     })
             self._dash_task = asyncio.ensure_future(self._dash_sample_loop())
         except Exception:
@@ -1311,6 +1337,7 @@ class HeadService(RpcHost):
             "placement_groups": [p.info(self.nodes)
                                  for p in self.placement_groups.values()],
             "jobs": jobs,
+            "traces": self._trace_summaries(50),
             "series": list(self._dash_series),
             "summary": {
                 "cpus_avail": round(avail, 2), "cpus_total": round(total, 2),
@@ -1324,31 +1351,21 @@ class HeadService(RpcHost):
 
     def _render_timeline_json(self):
         """Chrome-trace events straight off the task-event store (same
-        shape as util.state.timeline / `rtpu timeline`)."""
+        shape as util.state.timeline / `rtpu timeline`): duration
+        slices, submit→execute flow arrows, and instant events for
+        queue-time failures."""
         import json as _json
 
-        events = []
-        for t in self.task_events.values():
-            start = t.get("running_ts")
-            end = t.get("finished_ts") or t.get("failed_ts")
-            if start is None or end is None:
-                continue
-            events.append({
-                "name": t.get("name", t.get("task_id", "")[:8]),
-                "cat": t.get("kind", "task"), "ph": "X",
-                "ts": int(start * 1e6),
-                "dur": max(1, int((end - start) * 1e6)),
-                "pid": t.get("node_id", "")[:8],
-                "tid": t.get("worker_id", "")[:8],
-                "args": {"task_id": t.get("task_id"),
-                         "state": t.get("state")},
-            })
+        from ray_tpu.util.state.api import task_timeline_events
+
+        events = task_timeline_events(list(self.task_events.values()))
         return "application/json", _json.dumps(events).encode()
 
     async def rpc_task_events(self, events: List[Dict[str, Any]]):
         """Workers flush task state transitions here in batches
         (reference: task_event_buffer.h -> gcs_task_manager.h)."""
-        rank = {"SUBMITTED": 0, "RUNNING": 1, "FINISHED": 2, "FAILED": 2}
+        rank = {"SUBMITTED": 0, "LEASED": 1, "RUNNING": 2,
+                "FINISHED": 3, "FAILED": 3}
         for ev in events:
             tid = ev.get("task_id", "")
             if not tid:
@@ -1360,16 +1377,50 @@ class HeadService(RpcHost):
                 if v is None:
                     continue
                 if k == "state":
-                    # owner (SUBMITTED) and executor (RUNNING/...) flush
-                    # on independent clocks; a late-arriving earlier
+                    # owner (SUBMITTED/LEASED) and executor (RUNNING/...)
+                    # flush on independent clocks; a late-arriving earlier
                     # state must not regress the record
                     if rank.get(v, 0) < rank.get(rec.get("state"), -1):
                         continue
                 rec[k] = v
+            self._observe_sched_latency(rec)
         cap = config.task_events_buffer_size
         while len(self.task_events) > cap:
-            self.task_events.pop(next(iter(self.task_events)))
+            oldest = next(iter(self.task_events))
+            self.task_events.pop(oldest)
+            self._sched_observed.pop(oldest, None)
         return {"ok": True}
+
+    def _observe_sched_latency(self, rec: Dict[str, Any]) -> None:
+        """Once a task record is terminal, decompose its lifetime into
+        queued→leased→running→finished phase durations and feed the
+        ray_tpu_task_sched_latency_seconds histogram.
+
+        Each phase is observed at most once per task, but independently:
+        the executor's RUNNING/FINISHED batch usually lands before the
+        owner's SUBMITTED/LEASED batch (the owner holds non-terminal
+        events for its periodic flush), so the queued/leased phases only
+        become computable on a later merge.  Negative deltas (events
+        stamped by different process clocks) clamp to 0."""
+        if self._sched_hist is None:
+            return
+        if rec.get("state") not in ("FINISHED", "FAILED"):
+            return
+        done = self._sched_observed.setdefault(rec.get("task_id", ""), set())
+        sub = rec.get("submitted_ts")
+        leased = rec.get("leased_ts")
+        run = rec.get("running_ts")
+        end = rec.get("finished_ts") or rec.get("failed_ts")
+        h = self._sched_hist
+        if "queued" not in done and sub is not None and leased is not None:
+            done.add("queued")
+            h.observe(max(0.0, leased - sub), tags={"phase": "queued"})
+        if "leased" not in done and leased is not None and run is not None:
+            done.add("leased")
+            h.observe(max(0.0, run - leased), tags={"phase": "leased"})
+        if "running" not in done and run is not None and end is not None:
+            done.add("running")
+            h.observe(max(0.0, end - run), tags={"phase": "running"})
 
     async def rpc_list_tasks(self, state: str = "", name: str = "",
                              limit: int = 1000):
@@ -1383,6 +1434,91 @@ class HeadService(RpcHost):
             if len(out) >= limit:
                 break
         return {"tasks": out}
+
+    # ---- distributed-trace store (see _private/tracing.py; reference:
+    # ray.util.tracing exports spans to an external collector — here a
+    # bounded in-head store queryable via RPC, HTTP and CLI) ---------------
+
+    async def rpc_trace_spans(self, spans: List[Dict[str, Any]]):
+        """Workers flush finished spans here alongside task events."""
+        max_traces = config.trace_store_max_traces
+        max_spans = config.trace_store_max_spans
+        for s in spans:
+            trace_id = s.get("trace_id")
+            if not trace_id:
+                continue
+            ent = self.traces.get(trace_id)
+            if ent is None:
+                while len(self.traces) >= max_traces:
+                    self.traces.pop(next(iter(self.traces)))
+                ent = self.traces[trace_id] = {
+                    "trace_id": trace_id, "spans": [],
+                    "start": s.get("start", 0.0), "end": 0.0, "root": "",
+                }
+            if len(ent["spans"]) >= max_spans:
+                self._trace_spans_dropped += 1
+                continue
+            ent["spans"].append(s)
+            start = s.get("start") or 0.0
+            if start and (not ent["start"] or start < ent["start"]):
+                ent["start"] = start
+            ent["end"] = max(ent["end"], s.get("end") or 0.0)
+            if not s.get("parent_id"):
+                ent["root"] = s.get("name", "")
+        return {"ok": True}
+
+    def _trace_summary(self, ent: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            "trace_id": ent["trace_id"],
+            "num_spans": len(ent["spans"]),
+            "root": ent.get("root", ""),
+            "start": ent.get("start", 0.0),
+            "end": ent.get("end", 0.0),
+            "duration_s": max(0.0, (ent.get("end") or 0.0)
+                              - (ent.get("start") or 0.0)),
+        }
+
+    def _trace_summaries(self, limit: int) -> List[Dict[str, Any]]:
+        """Newest-first summaries (shared by the RPC, HTTP and dashboard
+        surfaces so they can't drift apart)."""
+        out = [self._trace_summary(e)
+               for e in reversed(list(self.traces.values()))]
+        return out[:max(0, limit)]
+
+    def _trace_detail(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        """Summary + start-sorted spans for one trace, or None."""
+        ent = self.traces.get(trace_id)
+        if ent is None:
+            return None
+        trace = self._trace_summary(ent)
+        trace["spans"] = sorted(ent["spans"],
+                                key=lambda s: s.get("start", 0.0))
+        return trace
+
+    async def rpc_list_traces(self, limit: int = 100):
+        return {"traces": self._trace_summaries(limit),
+                "spans_dropped": self._trace_spans_dropped}
+
+    async def rpc_get_trace(self, trace_id: str):
+        trace = self._trace_detail(trace_id)
+        if trace is None:
+            return {"found": False}
+        return {"found": True, "trace": trace}
+
+    def _render_traces_json(self):
+        import json as _json
+
+        return "application/json", _json.dumps(
+            self._trace_summaries(100), default=str).encode()
+
+    def _render_one_trace_json(self, trace_id: str = ""):
+        import json as _json
+
+        trace = self._trace_detail(trace_id.strip("/"))
+        if trace is None:
+            body = _json.dumps({"error": f"no trace {trace_id!r}"})
+            return "application/json", body.encode()
+        return "application/json", _json.dumps(trace, default=str).encode()
 
     async def rpc_metrics_port(self):
         return {"port": self.metrics_port}

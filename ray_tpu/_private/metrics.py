@@ -261,11 +261,25 @@ async def start_metrics_http_server(registry: MetricsRegistry,
                                     ) -> Tuple[asyncio.AbstractServer, int]:
     """Minimal HTTP/1.0 exposition endpoint: `GET /metrics`, plus any
     ``extra_routes`` ({path: () -> (content_type, bytes)}) — the head
-    mounts its dashboard page here.
+    mounts its dashboard page here.  A route key ENDING in "/" is a
+    prefix route: its handler is called with the remaining path suffix
+    (e.g. "/api/traces/" serves /api/traces/<trace_id>).
 
     Handcrafted on asyncio (no aiohttp in the image); Prometheus needs
     nothing beyond status line + content-type + body."""
     extra_routes = extra_routes or {}
+
+    def _match(path: str):
+        """Exact route → (handler, None); prefix route → (handler,
+        suffix); no match → (None, None)."""
+        h = extra_routes.get(path)
+        if h is not None:
+            return h, None
+        for key, fn in extra_routes.items():
+            if len(key) > 1 and key.endswith("/") \
+                    and path.startswith(key) and len(path) > len(key):
+                return fn, path[len(key):]
+        return None, None
 
     async def handle(reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter):
@@ -278,9 +292,10 @@ async def start_metrics_http_server(registry: MetricsRegistry,
             parts = request.decode("latin-1").split()
             path = (parts[1] if len(parts) >= 2 else "/").split("?")[0]
             ctype = b"text/plain; version=0.0.4"
-            if path in extra_routes:
+            route, suffix = _match(path)
+            if route is not None:
                 try:
-                    ct, body = extra_routes[path]()
+                    ct, body = route() if suffix is None else route(suffix)
                     ctype = ct.encode()
                     status = b"200 OK"
                 except Exception as e:  # route bug must not kill serving

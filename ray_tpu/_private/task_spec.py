@@ -82,6 +82,10 @@ class TaskSpec:
     # {} | {"type": "spread"} | {"type": "node_affinity", ...} |
     # {"type": "node_label", "hard": {...}} (see util/scheduling_strategies)
     scheduling_strategy: Dict[str, Any] = field(default_factory=dict)
+    # active trace context at submission ({"tid": ..., "sid": ...});
+    # only present for sampled traces — the worker-side execute span
+    # parents to it (see _private/tracing.py)
+    trace_ctx: Optional[Dict[str, str]] = None
 
     def resource_set(self) -> ResourceSet:
         return ResourceSet(self.resources)
@@ -102,7 +106,7 @@ class TaskSpec:
                 json.dumps(self.scheduling_strategy, sort_keys=True))
 
     def to_wire(self) -> Dict[str, Any]:
-        return {
+        d = {
             "tid": self.task_id,
             "jid": self.job_id,
             "kind": self.kind,
@@ -124,6 +128,9 @@ class TaskSpec:
             "renv": self.runtime_env,
             "strat": self.scheduling_strategy,
         }
+        if self.trace_ctx:
+            d["trace"] = self.trace_ctx
+        return d
 
     @classmethod
     def from_wire(cls, d: Dict[str, Any]) -> "TaskSpec":
@@ -149,4 +156,5 @@ class TaskSpec:
             bundle_index=d.get("bundle", -1),
             runtime_env=d.get("renv", {}),
             scheduling_strategy=d.get("strat", {}),
+            trace_ctx=d.get("trace"),
         )

@@ -56,6 +56,7 @@ from ray_tpu._private.object_store import PlasmaClient
 from ray_tpu._private.reference_count import ReferenceCounter
 from ray_tpu._private.streaming import (STREAMING, ObjectRefGenerator,
                                         StreamState)
+from ray_tpu._private import tracing
 from ray_tpu._private.rpc import (ConnectionLost, EventLoopThread, RpcClient,
                                   RpcError, RpcHost, RpcServer, SyncRpcClient)
 from ray_tpu._private.task_spec import (ACTOR_CREATION_TASK, ACTOR_TASK,
@@ -416,12 +417,29 @@ class CoreWorker(RpcHost):
 
     # ------------------------------------------------------- observability
 
-    def record_task_event(self, task_id: str, state: str, **fields) -> None:
+    def record_task_event(self, task_id: str, state: str,
+                          _executor: Optional[bool] = None,
+                          **fields) -> None:
         """Buffer a task state transition; flushed to the head in batches
-        (reference: task_event_buffer.h FlushEvents)."""
+        (reference: task_event_buffer.h FlushEvents).
+
+        `_executor` overrides the by-state attribution guess — the
+        owner records FAILED too (see _fail_task), and must not claim
+        the record's worker/node with its own identity."""
         ev = {"task_id": task_id, "state": state,
-              "worker_id": self.worker_id, "node_id": self.node_id,
               f"{state.lower()}_ts": time.time()}
+        if _executor is None:
+            _executor = state in ("RUNNING", "FINISHED", "FAILED")
+        if _executor:
+            # executor-side states claim the record's worker/node; the
+            # submitter's identity rides dedicated caller_* keys so a
+            # late-flushed owner event can't clobber the executor
+            # attribution (timeline tracks key off worker_id/node_id)
+            ev["worker_id"] = self.worker_id
+            ev["node_id"] = self.node_id
+        else:
+            ev["caller_worker_id"] = self.worker_id
+            ev["caller_node_id"] = self.node_id
         sub = os.environ.get("RT_JOB_ID")
         if sub:
             # correlate this driver's tasks with its job submission id
@@ -459,6 +477,17 @@ class CoreWorker(RpcHost):
                 await self.head.aio.oneway("task_events", events=batch)
             except Exception:
                 pass
+        # trace spans ride the same flush cadence (worker → head)
+        spans = tracing.drain()
+        if spans:
+            for s in spans:
+                s.setdefault("worker_id", self.worker_id)
+                s.setdefault("node_id", self.node_id)
+            try:
+                await self.head.aio.oneway("trace_spans", spans=spans)
+                tracing.count_flush()
+            except Exception:
+                tracing.count_dropped(len(spans))
 
     async def _observability_loop(self):
         import asyncio
@@ -623,6 +652,17 @@ class CoreWorker(RpcHost):
             try:
                 self._io.run(
                     self.head.aio.oneway("task_events", events=batch),
+                    timeout=2.0)
+            except Exception:
+                pass
+        spans = tracing.drain()
+        if spans:
+            for s in spans:
+                s.setdefault("worker_id", self.worker_id)
+                s.setdefault("node_id", self.node_id)
+            try:
+                self._io.run(
+                    self.head.aio.oneway("trace_spans", spans=spans),
                     timeout=2.0)
             except Exception:
                 pass
@@ -1215,6 +1255,15 @@ class CoreWorker(RpcHost):
             placement_group_id=placement_group_id,
             bundle_index=max(bundle_index, 0) if placement_group_id else -1)
         task = _TaskState(spec, contained)
+        # submit span: child of whatever span this thread/coroutine is
+        # running under (an executing task's span for nested submits, a
+        # Serve ingress span, …) or a fresh sampled root.  The worker's
+        # execute span parents to it via spec.trace_ctx; an unsampled
+        # decision propagates too so the subtree doesn't re-roll.
+        span, spec.trace_ctx = tracing.begin_submit(
+            "submit " + (name or function_id[:8]))
+        if span is not None:
+            span.set_attribute("task_id", spec.task_id)
         refs: List[Any] = []
         if num_returns == STREAMING:
             # yields arrive incrementally; no automatic retries (a
@@ -1239,6 +1288,8 @@ class CoreWorker(RpcHost):
                 self._loop().call_soon_threadsafe(self._enqueue_ready, task)
             except RuntimeError:
                 pass  # loop shut down
+        if span is not None:
+            span.end()
         return refs
 
     def _sched_state(self, key: tuple) -> _SchedState:
@@ -1382,6 +1433,15 @@ class CoreWorker(RpcHost):
             pass  # worker already gone: the push path resolves the task
 
     def _fail_task(self, task: _TaskState, error: BaseException):
+        # owner-side failures (cancelled while queued, worker death with
+        # no retries left, scheduling errors) never reach an executor —
+        # record FAILED here or the task-event store would show the task
+        # SUBMITTED forever and the timeline would silently drop it.
+        # _executor=False: if the task DID run (worker died mid-task),
+        # the executor's RUNNING event already attributed the record and
+        # this event must not re-stamp it with the owner's identity
+        self.record_task_event(task.spec.task_id, "FAILED",
+                               _executor=False, error=str(error)[:200])
         for oid in task.return_oids:
             self.memory.set_error(oid, error)
         if task.spec.num_returns == STREAMING:
@@ -1665,6 +1725,10 @@ class CoreWorker(RpcHost):
         return "retry"
 
     async def _push(self, state: _SchedState, lease: _Lease, task: _TaskState):
+        # LEASED marks dispatch to a leased worker; the head derives the
+        # queued (submitted→leased) and leased (leased→running) phases of
+        # ray_tpu_task_sched_latency_seconds from it
+        self.record_task_event(task.spec.task_id, "LEASED")
         try:
             c = await self._aclient_worker(lease.addr)
             reply = await c.call("push_task", spec=task.spec.to_wire(),
@@ -1726,6 +1790,7 @@ class CoreWorker(RpcHost):
         for task in tasks:
             self._batch_pending[task.spec.task_id] = (
                 "task", state, lease, task)
+            self.record_task_event(task.spec.task_id, "LEASED")
         try:
             c = await self._aclient_worker(lease.addr)
             await c.call(
@@ -1952,8 +2017,14 @@ class CoreWorker(RpcHost):
             scheduling_strategy=scheduling_strategy or {},
             placement_group_id=placement_group_id,
             bundle_index=max(bundle_index, 0) if placement_group_id else -1)
+        span, spec.trace_ctx = tracing.begin_submit(
+            "create_actor " + (name or class_id[:8]))
+        if span is not None:
+            span.set_attribute("actor_id", aid.hex())
         self.head.call("create_actor", spec=spec.to_wire(), name=name,
                        method_num_returns=method_num_returns or {})
+        if span is not None:
+            span.end()
         # hold arg refs until the actor is alive; the head owns creation
         astate = _ActorState(aid.hex())
         self._actors[aid.hex()] = astate
@@ -1977,6 +2048,11 @@ class CoreWorker(RpcHost):
             max_retries=max_retries, actor_id=actor_id,
             method_name=method_name, caller_id=self.worker_id,
             owner_addr=self.address)
+        span, spec.trace_ctx = tracing.begin_submit("submit " + method_name)
+        if span is not None:
+            span.set_attribute("task_id", spec.task_id)
+            span.set_attribute("actor_id", actor_id)
+            span.end()
         task = _TaskState(spec, contained)
         refs: List[Any] = []
         if num_returns == STREAMING:
@@ -2461,6 +2537,44 @@ class CoreWorker(RpcHost):
 
     def _execute(self, spec_wire: Dict[str, Any],
                  conn=None) -> Dict[str, Any]:
+        """Tracing wrapper: a sampled submission carries its context in
+        the spec; the execute span parents to the caller's submit span,
+        and — via the contextvar — any `.remote()` the task body makes
+        chains into the same trace (reference: tracing_helper.py
+        _inject_tracing_into_function)."""
+        ctx = tracing.ctx_from_wire(spec_wire.get("trace"))
+        if ctx is None:
+            return self._execute_inner(spec_wire, conn)
+        if not ctx.sampled:
+            # inherit the caller's negative decision: nested submits
+            # from the task body must not re-roll sampling
+            token = tracing.activate(ctx)
+            try:
+                return self._execute_inner(spec_wire, conn)
+            finally:
+                tracing.restore(token)
+        span = tracing.start_span(
+            "execute " + (spec_wire.get("name")
+                          or spec_wire.get("method")
+                          or spec_wire.get("fid", "")[:8] or "task"),
+            kind=tracing.KIND_SERVER, parent=ctx)
+        if span is None:  # tracing disabled in this worker
+            return self._execute_inner(spec_wire, conn)
+        span.set_attribute("task_id", spec_wire.get("tid", ""))
+        token = tracing.activate(span.context())
+        try:
+            reply = self._execute_inner(spec_wire, conn)
+        except BaseException as e:  # pragma: no cover — inner returns
+            span.end(error=f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            tracing.restore(token)
+        span.end(error=reply.get("error_str", "")
+                 if reply.get("error") else "")
+        return reply
+
+    def _execute_inner(self, spec_wire: Dict[str, Any],
+                       conn=None) -> Dict[str, Any]:
         spec = TaskSpec.from_wire(spec_wire)
         self._exec.task_id = spec.task_id
         self._exec.job_id = spec.job_id

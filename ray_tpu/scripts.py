@@ -15,6 +15,8 @@ python/ray/util/state/state_cli.py).  Installed as `rtpu` via
   rtpu job list
   rtpu summary tasks|actors|objects
   rtpu timeline -o trace.json
+  rtpu trace list [--limit N]
+  rtpu trace get TRACE_ID [-o trace.json]
 
 Cluster discovery: `start --head` records the address in
 $RT_TMPDIR/latest_cluster.json; other commands use --address,
@@ -217,6 +219,45 @@ def cmd_summary(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Inspect distributed traces straight off the head's trace store
+    (no driver attach needed — plain head RPCs)."""
+    addr = _resolve_address(args.address)
+    head, io = _head_client(addr)
+    try:
+        if args.trace_cmd == "list":
+            reply = head.call("list_traces", limit=args.limit, timeout=10)
+            traces = reply["traces"]
+            if not traces:
+                print("no traces recorded (tracing disabled, sampled "
+                      "out, or nothing ran yet)")
+                return 0
+            for t in traces:
+                print(f"{t['trace_id']}  spans={t['num_spans']:<4} "
+                      f"dur={t['duration_s'] * 1000:8.1f}ms  "
+                      f"root={t.get('root', '')}")
+            if reply.get("spans_dropped"):
+                print(f"(head dropped {reply['spans_dropped']} spans "
+                      f"over the per-trace cap)", file=sys.stderr)
+            return 0
+        reply = head.call("get_trace", trace_id=args.trace_id, timeout=10)
+        if not reply.get("found"):
+            print(f"no trace {args.trace_id!r}", file=sys.stderr)
+            return 1
+        blob = json.dumps(reply["trace"], indent=2, default=str)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(blob)
+            print(f"wrote {len(reply['trace']['spans'])} spans to "
+                  f"{args.output}")
+        else:
+            print(blob)
+        return 0
+    finally:
+        head.close()
+        io.stop()
+
+
 def cmd_timeline(args) -> int:
     import ray_tpu
 
@@ -281,6 +322,16 @@ def main(argv=None) -> int:
     p.add_argument("-o", "--output", default="timeline.json")
     p.add_argument("--address", default="")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("trace", help="inspect distributed traces")
+    p.add_argument("--address", default="")
+    tsub = p.add_subparsers(dest="trace_cmd", required=True)
+    tl = tsub.add_parser("list", help="recent traces, newest first")
+    tl.add_argument("--limit", type=int, default=20)
+    tg = tsub.add_parser("get", help="dump one trace's spans as JSON")
+    tg.add_argument("trace_id")
+    tg.add_argument("-o", "--output", default="")
+    p.set_defaults(fn=cmd_trace)
 
     args = ap.parse_args(argv)
     # strip a leading "--" from REMAINDER entrypoints

@@ -460,19 +460,24 @@ class ServeController:
 
     def _reconcile_loop(self):
         import ray_tpu
+        from ray_tpu._private import tracing
 
-        while not self._stop.wait(self.RECONCILE_PERIOD_S):
-            with self._lock:
-                apps = dict(self.apps)
-            for name, app in apps.items():
+        # suppressed: health probes + replacement churn would otherwise
+        # mint a root trace every period and evict real traces from the
+        # head's bounded store
+        with tracing.suppressed():
+            while not self._stop.wait(self.RECONCILE_PERIOD_S):
+                with self._lock:
+                    apps = dict(self.apps)
+                for name, app in apps.items():
+                    try:
+                        self._reconcile_one(ray_tpu, name, app)
+                    except Exception:
+                        pass  # never let one deployment wedge the loop
                 try:
-                    self._reconcile_one(ray_tpu, name, app)
+                    self._refresh_replica_nodes()
                 except Exception:
-                    pass  # never let one deployment wedge the loop
-            try:
-                self._refresh_replica_nodes()
-            except Exception:
-                pass
+                    pass
 
     def _reconcile_one(self, ray_tpu, name: str, app: Dict[str, Any]):
         # 1. health: drop replicas that fail a health probe
@@ -767,21 +772,24 @@ class _MetricsPusher:
                 self._thread.start()
 
     def _run(self):
-        while True:
-            time.sleep(self.SAMPLE_PERIOD_S)
-            with self._lock:
-                live = [(r, h) for r in self._handles
-                        if (h := r()) is not None]
-                self._handles = [r for r, _ in live]
-                if not live:
-                    self._thread = None  # retire; register() restarts
-                    return
-            now = time.monotonic()
-            for _, h in live:
-                try:
-                    self._sample_and_push(h, now)
-                except Exception:
-                    pass  # runtime down or controller restarting
+        from ray_tpu._private import tracing
+
+        with tracing.suppressed():  # metric pushes are not user traffic
+            while True:
+                time.sleep(self.SAMPLE_PERIOD_S)
+                with self._lock:
+                    live = [(r, h) for r in self._handles
+                            if (h := r()) is not None]
+                    self._handles = [r for r, _ in live]
+                    if not live:
+                        self._thread = None  # retire; register() restarts
+                        return
+                now = time.monotonic()
+                for _, h in live:
+                    try:
+                        self._sample_and_push(h, now)
+                    except Exception:
+                        pass  # runtime down or controller restarting
 
     def _sample_and_push(self, h, now: float) -> None:
         with h._lock:
@@ -893,7 +901,22 @@ class DeploymentHandle:
                           key=lambda r: self._inflight.get(r._actor_id, 0))
             rid = replica._actor_id
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
-        ref = replica.handle_request.remote(_method, args, kwargs)
+        # handle-call span: ties a Serve request (HTTP ingress span or an
+        # in-cluster caller's active trace) to the replica-side actor
+        # task — the submit/execute spans chain under it automatically
+        from ray_tpu._private import tracing
+
+        span = tracing.start_span(f"serve.handle {self._name}",
+                                  kind=tracing.KIND_CLIENT,
+                                  attributes={"replica_id": rid,
+                                              "method": _method})
+        token = tracing.activate(span.context()) if span else None
+        try:
+            ref = replica.handle_request.remote(_method, args, kwargs)
+        finally:
+            if span is not None:
+                tracing.restore(token)
+                span.end()
 
         def _done_cb(rid=rid):
             with self._lock:
@@ -924,8 +947,20 @@ class DeploymentHandle:
                           key=lambda r: self._inflight.get(r._actor_id, 0))
             rid = replica._actor_id
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
-        gen = replica.stream_request.options(
-            num_returns="streaming").remote(_method, args, kwargs)
+        from ray_tpu._private import tracing
+
+        span = tracing.start_span(f"serve.stream {self._name}",
+                                  kind=tracing.KIND_CLIENT,
+                                  attributes={"replica_id": rid,
+                                              "method": _method})
+        token = tracing.activate(span.context()) if span else None
+        try:
+            gen = replica.stream_request.options(
+                num_returns="streaming").remote(_method, args, kwargs)
+        finally:
+            if span is not None:
+                tracing.restore(token)
+                span.end()
         released = [False]
 
         def _release(rid=rid):

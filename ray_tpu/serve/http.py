@@ -121,6 +121,37 @@ class _HttpProxy:
                 pass
 
     async def _route(self, method: str, target: str, headers, body: bytes):
+        """Tracing wrapper around the actual routing: an inbound W3C
+        ``traceparent`` header continues the external caller's trace
+        (reference: serve's OTel middleware); a malformed header is
+        ignored — the request proceeds untraced-from-outside but still
+        starts its own sampled root.  The ingress span context is handed
+        to the executor-thread handle call explicitly because
+        run_in_executor does not carry contextvars."""
+        from ray_tpu._private import tracing
+
+        path = urlsplit(target).path
+        if path.strip("/") == "-/healthz":
+            return await self._route_inner(method, target, headers, body,
+                                           None)
+        span = tracing.start_span(
+            f"http {method} {path}", kind=tracing.KIND_SERVER,
+            parent=tracing.parse_traceparent(headers.get("traceparent")))
+        if span is None:
+            return await self._route_inner(method, target, headers, body,
+                                           None)
+        try:
+            status, payload, stream = await self._route_inner(
+                method, target, headers, body, span.context())
+        except BaseException as e:
+            span.end(error=f"{type(e).__name__}: {e}")
+            raise
+        span.set_attribute("http.status", status.split(" ", 1)[0])
+        span.end(error="" if status.startswith("2") else status)
+        return status, payload, stream
+
+    async def _route_inner(self, method: str, target: str, headers,
+                           body: bytes, trace_ctx):
         import asyncio
 
         parts = urlsplit(target)
@@ -148,10 +179,10 @@ class _HttpProxy:
         try:
             if want_stream:
                 gen = await loop.run_in_executor(
-                    None, self._stream_blocking, path, arg)
+                    None, self._stream_blocking, path, arg, trace_ctx)
                 return "200 OK", b"", gen
             result = await loop.run_in_executor(
-                None, self._call_blocking, path, arg)
+                None, self._call_blocking, path, arg, trace_ctx)
         except KeyError:
             return "404 Not Found", json.dumps(
                 {"error": f"no deployment named {path!r}"}).encode(), None
@@ -195,7 +226,23 @@ class _HttpProxy:
         writer.write(b"0\r\n\r\n")
         await writer.drain()
 
-    def _stream_blocking(self, name: str, arg: Any):
+    @staticmethod
+    def _with_trace(trace_ctx, fn, *args):
+        """Run fn with the ingress span active, restoring the thread's
+        context immediately after — the window is kept tight because
+        executor threads are shared across requests (and a generator
+        frame resuming on one must not leak its context)."""
+        if trace_ctx is None:
+            return fn(*args)
+        from ray_tpu._private import tracing
+
+        token = tracing.activate(trace_ctx)
+        try:
+            return fn(*args)
+        finally:
+            tracing.restore(token)
+
+    def _stream_blocking(self, name: str, arg: Any, trace_ctx=None):
         """Resolve the handle and return an iterator of ITEM VALUES
         (refs resolved here, off the event loop).  Like _call_blocking,
         a stale cached handle (replicas replaced wholesale) refreshes
@@ -207,7 +254,7 @@ class _HttpProxy:
 
         def _values():
             nonlocal handle
-            gen = handle.stream(arg)
+            gen = self._with_trace(trace_ctx, handle.stream, arg)
             yielded = retried = False
             while True:
                 try:
@@ -220,7 +267,7 @@ class _HttpProxy:
                         raise  # mid-stream death: cannot transparently restart
                     retried = True
                     handle = self._resolve_handle(name, fresh=True)
-                    gen = handle.stream(arg)
+                    gen = self._with_trace(trace_ctx, handle.stream, arg)
                     continue
                 yielded = True
                 yield value
@@ -241,16 +288,20 @@ class _HttpProxy:
             self._handles[name] = handle
         return handle
 
-    def _call_blocking(self, name: str, arg: Any):
+    def _call_blocking(self, name: str, arg: Any, trace_ctx=None):
         import ray_tpu
 
         handle = self._resolve_handle(name)
         try:
-            return ray_tpu.get(handle.remote(arg), timeout=120)
+            return ray_tpu.get(
+                self._with_trace(trace_ctx, handle.remote, arg),
+                timeout=120)
         except ray_tpu.RayError:
             # replicas may have been replaced wholesale: refresh once
             handle = self._resolve_handle(name, fresh=True)
-            return ray_tpu.get(handle.remote(arg), timeout=120)
+            return ray_tpu.get(
+                self._with_trace(trace_ctx, handle.remote, arg),
+                timeout=120)
 
 
 def _proxy_name(node_id: str) -> str:

@@ -1,4 +1,4 @@
-from ray_tpu.util.state.api import (get_log, list_actors,  # noqa: F401
-                                    list_nodes, list_objects,
+from ray_tpu.util.state.api import (get_log, get_trace,  # noqa: F401
+                                    list_actors, list_nodes, list_objects,
                                     list_placement_groups, list_tasks,
-                                    summarize_tasks, timeline)
+                                    list_traces, summarize_tasks, timeline)

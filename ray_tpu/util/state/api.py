@@ -58,30 +58,98 @@ def summarize_tasks() -> Dict[str, Dict[str, int]]:
     return out
 
 
+def task_timeline_events(records) -> List[Dict[str, Any]]:
+    """Chrome-trace events from merged task records (shared by
+    `timeline()` and the head's /api/timeline):
+
+    - one ``ph:"X"`` duration slice per executed task (as before);
+    - ``ph:"s"``/``ph:"f"`` flow events tying the submit point (on the
+      submitter's track) to the execution slice (on the executor's
+      track), so Perfetto draws submit→execute causality arrows;
+    - one ``ph:"i"`` instant event for tasks that FAILED without ever
+      reaching ``running`` (cancelled/errored while queued) — previously
+      these were silently dropped from the trace.
+    """
+    events: List[Dict[str, Any]] = []
+    for t in records:
+        start = t.get("running_ts")
+        end = t.get("finished_ts") or t.get("failed_ts")
+        name = t.get("name") or t.get("task_id", "")[:8]
+        if start is not None and end is not None:
+            events.append({
+                "name": name,
+                "cat": t.get("kind", "task"),
+                "ph": "X",
+                "ts": int(start * 1e6),
+                "dur": max(1, int((end - start) * 1e6)),
+                "pid": t.get("node_id", "")[:8],
+                "tid": t.get("worker_id", "")[:8],
+                "args": {"task_id": t.get("task_id"),
+                         "state": t.get("state")},
+            })
+            sub = t.get("submitted_ts")
+            if sub is not None:
+                fid = t.get("task_id", "")[:16]
+                events.append({
+                    "name": "submit", "cat": "task_flow", "ph": "s",
+                    "id": fid, "ts": int(sub * 1e6),
+                    "pid": (t.get("caller_node_id")
+                            or t.get("node_id", ""))[:8],
+                    "tid": (t.get("caller_worker_id")
+                            or t.get("worker_id", ""))[:8],
+                })
+                events.append({
+                    "name": "submit", "cat": "task_flow", "ph": "f",
+                    "bt": "e", "id": fid,
+                    "ts": int(start * 1e6),
+                    "pid": t.get("node_id", "")[:8],
+                    "tid": t.get("worker_id", "")[:8],
+                })
+        elif end is not None:
+            # never ran: instant event at the failure point so
+            # queue-time failures stay visible in the trace
+            events.append({
+                "name": name,
+                "cat": t.get("kind", "task"),
+                "ph": "i", "s": "p",
+                "ts": int(end * 1e6),
+                "pid": (t.get("node_id")
+                        or t.get("caller_node_id", ""))[:8],
+                "tid": (t.get("worker_id")
+                        or t.get("caller_worker_id", ""))[:8],
+                "args": {"task_id": t.get("task_id"),
+                         "state": t.get("state"),
+                         "error": t.get("error", "")},
+            })
+    return events
+
+
 def timeline(path: Optional[str] = None) -> List[Dict[str, Any]]:
     """Chrome-trace events (chrome://tracing / perfetto) from the task
     event store (reference: ray.timeline(), task profile events).
     Returns the event list; writes JSON to `path` if given."""
-    events: List[Dict[str, Any]] = []
-    for t in list_tasks(limit=100_000):
-        start = t.get("running_ts")
-        end = t.get("finished_ts") or t.get("failed_ts")
-        if start is None or end is None:
-            continue
-        events.append({
-            "name": t.get("name", t["task_id"][:8]),
-            "cat": t.get("kind", "task"),
-            "ph": "X",
-            "ts": int(start * 1e6),
-            "dur": max(1, int((end - start) * 1e6)),
-            "pid": t.get("node_id", "")[:8],
-            "tid": t.get("worker_id", "")[:8],
-            "args": {"task_id": t["task_id"], "state": t.get("state")},
-        })
+    events = task_timeline_events(list_tasks(limit=100_000))
     if path:
         with open(path, "w") as f:
             json.dump(events, f)
     return events
+
+
+def list_traces(limit: int = 100) -> List[Dict[str, Any]]:
+    """Summaries of recent traces from the head's trace store, newest
+    first (reference: ray.util.tracing — exported spans, here queryable
+    in-cluster)."""
+    return _head().call("list_traces", limit=limit)["traces"]
+
+
+def get_trace(trace_id: str) -> Dict[str, Any]:
+    """One trace: every flushed span, sorted by start time.  Raises
+    ValueError if the trace is unknown (not sampled, expired from the
+    bounded store, or not flushed yet)."""
+    reply = _head().call("get_trace", trace_id=trace_id)
+    if not reply.get("found"):
+        raise ValueError(f"no trace {trace_id!r} in the trace store")
+    return reply["trace"]
 
 
 def get_log(node_id: str = "", filename: str = "",
@@ -102,8 +170,14 @@ def get_log(node_id: str = "", filename: str = "",
             return ""
         session = sessions[-1]
     logs = os.path.join(session, "logs")
-    target = os.path.join(logs, filename) if filename else None
-    if target is None or not os.path.exists(target):
+    if filename:
+        # an explicit filename must resolve exactly — silently falling
+        # back to "latest log" here returned the WRONG file on typos
+        target = os.path.join(logs, filename)
+        if not os.path.exists(target):
+            raise FileNotFoundError(
+                f"no log file {filename!r} under {logs}")
+    else:
         candidates = sorted(glob.glob(os.path.join(logs, "*.log")))
         if not candidates:
             return ""

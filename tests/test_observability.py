@@ -28,6 +28,79 @@ def _scrape(port: int) -> str:
         return r.read().decode()
 
 
+def _assert_valid_exposition(text: str) -> None:
+    """Validate Prometheus text exposition format (the contract every
+    scraper relies on): HELP/TYPE headers come at most once per family,
+    a family's samples are contiguous, sample lines parse as
+    name{labels} value, and histogram buckets are cumulative with a
+    +Inf terminal matching _count."""
+    import re
+
+    sample_re = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'               # metric name
+        r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'         # first label
+        r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'    # more labels
+        r' [-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|nan|inf)$')
+    typed: dict = {}
+    helped: set = set()
+    family_of_sample = {}
+    last_family = None
+    families_seen_done = set()
+    for i, ln in enumerate(text.splitlines()):
+        if not ln.strip():
+            continue
+        if ln.startswith("# HELP "):
+            name = ln.split()[2]
+            assert name not in helped, f"duplicate HELP for {name}"
+            helped.add(name)
+            continue
+        if ln.startswith("# TYPE "):
+            parts = ln.split()
+            name, kind = parts[2], parts[3]
+            assert name not in typed, f"duplicate TYPE for {name}"
+            assert kind in ("counter", "gauge", "histogram", "summary",
+                            "untyped"), ln
+            typed[name] = kind
+            continue
+        assert not ln.startswith("#"), f"bad comment line: {ln!r}"
+        m = sample_re.match(ln)
+        assert m, f"unparsable sample line {i}: {ln!r}"
+        name = m.group(1)
+        base = name
+        for suffix in ("_bucket", "_count", "_sum"):
+            if name.endswith(suffix) and name[:-len(suffix)] in typed:
+                base = name[:-len(suffix)]
+        family_of_sample[name] = base
+        # contiguity: once a family ends, it must not reappear
+        if base != last_family:
+            assert base not in families_seen_done, \
+                f"family {base} interleaved (line {i}: {ln!r})"
+            if last_family is not None:
+                families_seen_done.add(last_family)
+            last_family = base
+    # histogram buckets cumulative and consistent with _count
+    for fam, kind in typed.items():
+        if kind != "histogram":
+            continue
+        buckets: dict = {}
+        counts: dict = {}
+        for ln in text.splitlines():
+            if ln.startswith(fam + "_bucket"):
+                labels = ln[len(fam + "_bucket"):].split(" ")[0]
+                le = labels.split('le="')[1].split('"')[0]
+                key = labels.replace(f'le="{le}"', "").strip("{},")
+                buckets.setdefault(key, []).append(float(ln.rsplit(" ", 1)[1]))
+            elif ln.startswith(fam + "_count"):
+                labels, v = ln[len(fam + "_count"):].rsplit(" ", 1)
+                counts[labels.strip("{}")] = float(v)
+        for key, vals in buckets.items():
+            assert vals == sorted(vals), \
+                f"{fam} buckets not cumulative for {{{key}}}: {vals}"
+            if key in counts:
+                assert vals[-1] == counts[key], \
+                    f"{fam} +Inf bucket != _count for {{{key}}}"
+
+
 def _agent_metrics_port() -> int:
     w = ray_tpu.api._worker()
     return w.agent.call("metrics_port")["port"]
@@ -66,6 +139,44 @@ def test_head_prometheus_endpoint(cluster):
     assert "rt_head_nodes" in text
     assert "rt_head_nodes 1.0" in text or "rt_head_nodes 1 " in text \
         or "rt_head_nodes 1\n" in text
+
+
+def test_metrics_exposition_format_valid(cluster):
+    """Both scrape targets must emit parseable Prometheus exposition
+    text — guards the handcrafted renderer (and the merge of worker
+    pushes) against format drift as metrics are added."""
+    @ray_tpu.remote
+    def f(x):
+        return x
+
+    ray_tpu.get([f.remote(i) for i in range(20)], timeout=60)
+    head_port, agent_port = _head_metrics_port(), _agent_metrics_port()
+    deadline = time.monotonic() + 60
+    head_text = agent_text = ""
+    while time.monotonic() < deadline:
+        head_text, agent_text = _scrape(head_port), _scrape(agent_port)
+        # wait until the interesting families are present so the
+        # validation actually covers them (worker push + head ingest)
+        if "ray_tpu_task_sched_latency_seconds_bucket" in head_text \
+                and "rt_tasks_finished" in agent_text:
+            break
+        time.sleep(0.5)
+    _assert_valid_exposition(head_text)
+    _assert_valid_exposition(agent_text)
+    # the new head-side families are exposed
+    assert "ray_tpu_task_sched_latency_seconds" in head_text
+    for phase in ("queued", "leased", "running"):
+        assert f'phase="{phase}"' in head_text, phase
+    assert "rt_head_traces" in head_text
+    # tracing self-metrics ride the worker push to the agent endpoint
+    deadline = time.monotonic() + 45
+    while time.monotonic() < deadline:
+        agent_text = _scrape(agent_port)
+        if "rt_trace_spans_sampled" in agent_text:
+            break
+        time.sleep(0.5)
+    assert "rt_trace_spans_sampled" in agent_text
+    _assert_valid_exposition(agent_text)
 
 
 def test_user_metrics_exported(cluster):
@@ -197,7 +308,8 @@ def test_head_dashboard_spa(local_cluster):
     assert '<script src="/app.js">' in html.decode()
     ct, js = fetch("/app.js")
     assert ct.startswith("application/javascript")
-    for needle in ("api/snapshot", "sparkline", "Placement groups"):
+    for needle in ("api/snapshot", "sparkline", "Placement groups",
+                   "Traces"):
         assert needle in js.decode()
 
     # live state lands in the snapshot the app renders from
@@ -217,17 +329,32 @@ def test_head_dashboard_spa(local_cluster):
 
     snap = json.loads(fetch("/api/snapshot")[1])
     for key in ("nodes", "actors", "tasks", "placement_groups", "jobs",
-                "series", "summary"):
+                "traces", "series", "summary"):
         assert key in snap, key
     assert len(snap["nodes"]) == 1
     assert any(x["state"] == "ALIVE" for x in snap["actors"])
     assert any(t.get("state") == "FINISHED" for t in snap["tasks"])
     assert snap["summary"]["cpus_total"] > 0
 
-    # timeline download is a Chrome trace event list
-    events = json.loads(fetch("/api/timeline")[1])
-    assert isinstance(events, list) and events
-    assert all(e["ph"] == "X" and "ts" in e and "dur" in e for e in events)
+    # timeline download is a Chrome trace event list: duration slices
+    # plus flow events ("s"/"f" submit→execute arrows) and optional
+    # instant events for queue-time failures.  Poll: the executor's
+    # RUNNING/FINISHED events flush within ms but the owner's SUBMITTED
+    # half (which the flow start needs) rides the periodic flush tick.
+    deadline = time.monotonic() + 45
+    while True:
+        events = json.loads(fetch("/api/timeline")[1])
+        assert isinstance(events, list) and events
+        assert all(e["ph"] in ("X", "s", "f", "i") and "ts" in e
+                   for e in events)
+        slices = [e for e in events if e["ph"] == "X"]
+        assert slices and all("dur" in e for e in slices)
+        flow_starts = {e["id"] for e in events if e["ph"] == "s"}
+        flow_ends = {e["id"] for e in events if e["ph"] == "f"}
+        if flow_starts or time.monotonic() >= deadline:
+            break
+        time.sleep(0.5)
+    assert flow_starts and flow_starts == flow_ends
 
     # legacy summary endpoint unchanged
     state = json.loads(fetch("/api/state")[1])

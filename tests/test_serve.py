@@ -152,14 +152,22 @@ def test_replica_death_recovery(cluster):
     handle = serve.run(f.bind())
     assert ray_tpu.get(handle.remote(1), timeout=60) == 1
     # kill one replica out from under the controller
+    killed = handle._replicas[0]._actor_id
     ray_tpu.kill(handle._replicas[0])
     from ray_tpu.serve.api import CONTROLLER_NAME
 
     ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
-    deadline = time.time() + 30
+    deadline = time.time() + 60
     ok = False
     while time.time() < deadline:
-        if ray_tpu.get(ctrl.list_deployments.remote(), timeout=30).get("sturdy") == 2:
+        # wait for the ACTUAL replacement: the dead replica gone from
+        # the roster and the count restored — a bare count==2 check
+        # passes before the controller even notices the death (the dead
+        # replica is still registered), letting the test race ahead to
+        # a handle refresh that re-learns the stale roster
+        info = ray_tpu.get(ctrl.get_replicas.remote("sturdy"), timeout=30)
+        ids = info["replica_ids"]
+        if len(ids) == 2 and killed not in ids:
             ok = True
             break
         time.sleep(0.5)

@@ -1,0 +1,372 @@
+"""Distributed tracing tests: cross-process span propagation, Serve
+traceparent continuation, timeline flow/instant events, trace store
+surfaces (state API / HTTP / CLI).
+
+Mirrors the reference's tracing suite (reference:
+python/ray/tests/test_tracing.py — task/actor spans parented across
+process boundaries via context injected into the task spec)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.state import get_trace, list_traces, timeline
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    """One cluster for the whole module: these tests only read the
+    (append-only) trace store and task events, so sharing the cluster
+    is safe and saves ~10 cluster boots of suite wall time."""
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    try:
+        yield ray_tpu
+    finally:
+        ray_tpu.shutdown()
+
+
+def _wait_for_trace(trace_id=None, min_spans=1, predicate=None,
+                    timeout=60.0):
+    """Poll the head's trace store until a matching trace lands (spans
+    flush on the observability cadence, not synchronously)."""
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        if trace_id is not None:
+            try:
+                t = get_trace(trace_id)
+                if t["num_spans"] >= min_spans:
+                    return t
+                last = t
+            except ValueError:
+                pass
+        else:
+            for summary in list_traces():
+                if summary["num_spans"] < min_spans:
+                    continue
+                t = get_trace(summary["trace_id"])
+                if predicate is None or predicate(t):
+                    return t
+                last = t
+        time.sleep(0.3)
+    raise AssertionError(f"trace never complete; last seen: {last}")
+
+
+def _assert_chained(trace):
+    """One root, every other span's parent present in the trace."""
+    spans = trace["spans"]
+    ids = {s["span_id"] for s in spans}
+    assert len({s["trace_id"] for s in spans}) == 1
+    roots = [s for s in spans if not s.get("parent_id")]
+    assert len(roots) <= 1, f"multiple roots: {roots}"
+    for s in spans:
+        if s.get("parent_id"):
+            assert s["parent_id"] in ids, \
+                f"dangling parent {s['parent_id'][:8]} on {s['name']}"
+
+
+def test_nested_task_single_trace(cluster):
+    """driver → task → nested subtask: one trace_id, ≥4 spans, correct
+    submit/execute parentage chain."""
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x), timeout=60) + 1
+
+    assert ray_tpu.get(outer.remote(1), timeout=60) == 3
+
+    def is_nested(t):
+        names = [s["name"] for s in t["spans"]]
+        return any("outer" in n for n in names) \
+            and any("inner" in n for n in names)
+
+    trace = _wait_for_trace(min_spans=4, predicate=is_nested)
+    _assert_chained(trace)
+    spans = {s["span_id"]: s for s in trace["spans"]}
+    by_name = {}
+    for s in trace["spans"]:
+        key = ("submit" if s["name"].startswith("submit") else "execute",
+               "inner" if "inner" in s["name"] else "outer")
+        by_name[key] = s
+    # execute parents to its submit; the nested submit parents to the
+    # outer execute span (it was made inside the task body)
+    assert spans[by_name[("execute", "outer")]["parent_id"]] \
+        is by_name[("submit", "outer")]
+    assert spans[by_name[("submit", "inner")]["parent_id"]] \
+        is by_name[("execute", "outer")]
+    assert spans[by_name[("execute", "inner")]["parent_id"]] \
+        is by_name[("submit", "inner")]
+    # kinds: submit-side CLIENT, execute-side SERVER
+    assert by_name[("submit", "outer")]["kind"] == "CLIENT"
+    assert by_name[("execute", "inner")]["kind"] == "SERVER"
+
+
+def test_actor_task_trace(cluster):
+    """Actor creation and method calls produce chained spans too."""
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.bump.remote(), timeout=60) == 1
+
+    def is_actor(t):
+        return any(s["name"] == "submit bump" for s in t["spans"])
+
+    trace = _wait_for_trace(min_spans=2, predicate=is_actor)
+    _assert_chained(trace)
+    execs = [s for s in trace["spans"] if s["name"] == "execute bump"]
+    subs = [s for s in trace["spans"] if s["name"] == "submit bump"]
+    assert execs and subs
+    assert execs[0]["parent_id"] == subs[0]["span_id"]
+
+
+def _http_serve_fixture():
+    from ray_tpu.serve import api as serve_api
+    from ray_tpu.serve import http as serve_http
+
+    @serve_api.deployment
+    class Echo:
+        def __call__(self, arg):
+            return {"echo": arg}
+
+    serve_api.run(Echo.bind(), name="traced_echo")
+    return serve_http.start_http()
+
+
+def test_serve_traceparent_continues_trace(cluster):
+    """An inbound W3C traceparent header's trace_id is continued through
+    ingress → handle → replica execution."""
+    host, port = _http_serve_fixture()
+    trace_id = "1f" * 16
+    req = urllib.request.Request(
+        f"http://{host}:{port}/traced_echo?q=1",
+        headers={"traceparent": f"00-{trace_id}-{'2e' * 8}-01"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        assert r.status == 200
+    trace = _wait_for_trace(trace_id=trace_id, min_spans=4)
+    names = [s["name"] for s in trace["spans"]]
+    assert any(n.startswith("http GET") for n in names), names
+    assert any(n.startswith("serve.handle") for n in names), names
+    assert any(n.startswith("execute") for n in names), names
+    # the ingress span is NOT a root: it parents to the external
+    # caller's span id from the header
+    http_span = next(s for s in trace["spans"]
+                     if s["name"].startswith("http GET"))
+    assert http_span["parent_id"] == "2e" * 8
+    spans = {s["span_id"] for s in trace["spans"]}
+    for s in trace["spans"]:
+        if s is not http_span and s.get("parent_id"):
+            assert s["parent_id"] in spans
+
+
+def test_serve_malformed_traceparent_ignored(cluster):
+    """Garbage traceparent headers must not error the request — the
+    request proceeds (with its own root trace)."""
+    host, port = _http_serve_fixture()
+    for bad in ("garbage", "00-zz-zz-zz", "00-" + "0" * 32 + "-" +
+                "1" * 16 + "-01", "ff-" + "a" * 32 + "-" + "b" * 16 +
+                "-01", ""):
+        req = urllib.request.Request(
+            f"http://{host}:{port}/traced_echo?q=2",
+            headers={"traceparent": bad} if bad else {})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.status == 200, bad
+            assert json.loads(r.read())["echo"] == {"q": "2"}
+
+
+def test_unsampled_submission_records_no_spans(cluster):
+    """With sampling off no spans accumulate — and the negative
+    decision propagates: a nested subtask must not re-roll sampling
+    and mint an orphan root trace mid-call-tree."""
+    import os
+
+    from ray_tpu._private import tracing
+
+    os.environ["RT_TRACE_SAMPLING_RATIO"] = "0.0"
+    try:
+        time.sleep(0.25)  # let the tracing config TTL cache expire
+        tracing.drain()  # clear anything buffered by the fixture
+        before = {t["trace_id"] for t in list_traces(limit=500)}
+
+        @ray_tpu.remote
+        def unsampled_inner():
+            return 1
+
+        @ray_tpu.remote
+        def unsampled_outer():
+            return ray_tpu.get(unsampled_inner.remote(), timeout=60) + 1
+
+        assert ray_tpu.get(unsampled_outer.remote(), timeout=60) == 2
+        assert tracing.drain() == []
+        # nothing from this tree reached the store (workers inherit the
+        # not-sampled marker instead of re-rolling)
+        time.sleep(1.5)  # one worker flush cadence
+        fresh = [get_trace(t["trace_id"])
+                 for t in list_traces(limit=500)
+                 if t["trace_id"] not in before]
+        assert not any("unsampled" in s["name"]
+                       for t in fresh for s in t["spans"]), fresh
+    finally:
+        os.environ.pop("RT_TRACE_SAMPLING_RATIO", None)
+
+
+def test_traceparent_roundtrip():
+    """format_traceparent emits what parse_traceparent accepts (the
+    outbound half of the W3C interop)."""
+    from ray_tpu._private import tracing
+
+    ctx = tracing.SpanContext(tracing.new_trace_id(),
+                              tracing.new_span_id(), True)
+    header = tracing.format_traceparent(ctx)
+    back = tracing.parse_traceparent(header)
+    assert back is not None
+    assert (back.trace_id, back.span_id, back.sampled) == \
+        (ctx.trace_id, ctx.span_id, True)
+    unsampled = tracing.SpanContext(ctx.trace_id, ctx.span_id, False)
+    back = tracing.parse_traceparent(tracing.format_traceparent(unsampled))
+    assert back is not None and not back.sampled
+
+
+def test_timeline_flow_events(cluster):
+    """The exported timeline carries ph:"s"/"f" flow events pairing the
+    submit point with the execution slice (Perfetto causality arrows)."""
+    @ray_tpu.remote
+    def traced_flow():
+        time.sleep(0.01)
+        return 1
+
+    ray_tpu.get([traced_flow.remote() for _ in range(3)], timeout=60)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        events = timeline()
+        slices = [e for e in events if e["ph"] == "X"
+                  and e["name"].endswith("traced_flow")]
+        if len(slices) >= 3:
+            break
+        time.sleep(0.3)
+    assert len(slices) >= 3
+    starts = {e["id"]: e for e in events if e["ph"] == "s"}
+    ends = {e["id"]: e for e in events if e["ph"] == "f"}
+    assert starts, "no flow-start events in the timeline"
+    assert set(starts) == set(ends)
+    for fid, s in starts.items():
+        f = ends[fid]
+        assert f.get("bt") == "e"
+        assert s["ts"] <= f["ts"], (s, f)
+        # the flow id ties back to the task the slice describes
+        matching = [e for e in events if e["ph"] == "X"
+                    and e["args"]["task_id"].startswith(fid)]
+        assert matching, fid
+
+
+def test_timeline_instant_event_for_queued_failure(cluster):
+    """A task cancelled while queued (never RUNNING) must appear in the
+    timeline as a ph:"i" instant event instead of being dropped."""
+    @ray_tpu.remote(num_cpus=4)
+    def hog():
+        time.sleep(3)
+        return "hog"
+
+    @ray_tpu.remote(num_cpus=4)
+    def starved():
+        return 1
+
+    h = hog.remote()  # occupies every CPU
+    time.sleep(0.3)
+    ref = starved.remote()  # stuck behind the hog, never RUNNING
+    time.sleep(0.2)
+    ray_tpu.cancel(ref)
+    with pytest.raises(ray_tpu.RayError):
+        ray_tpu.get(ref, timeout=30)
+    ray_tpu.get(h, timeout=60)  # drain the hog before the next test
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        inst = [e for e in timeline()
+                if e["ph"] == "i" and e["name"].endswith("starved")]
+        if inst:
+            break
+        time.sleep(0.3)
+    assert inst, "queue-time failure missing from the timeline"
+    assert inst[0]["args"]["state"] == "FAILED"
+    assert "cancel" in inst[0]["args"].get("error", "").lower()
+
+
+def test_trace_http_endpoints_and_store_bound(cluster):
+    """/api/traces + /api/traces/<id> serve the store over HTTP."""
+    @ray_tpu.remote
+    def probe():
+        return 1
+
+    ray_tpu.get(probe.remote(), timeout=60)
+    trace = _wait_for_trace(
+        min_spans=2,
+        predicate=lambda t: any("probe" in s["name"] for s in t["spans"]))
+    port = ray_tpu.api._worker().head.call("metrics_port")["port"]
+
+    def fetch(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return json.loads(r.read())
+
+    summaries = fetch("/api/traces")
+    assert any(t["trace_id"] == trace["trace_id"] for t in summaries)
+    one = fetch(f"/api/traces/{trace['trace_id']}")
+    assert one["trace_id"] == trace["trace_id"]
+    assert len(one["spans"]) == trace["num_spans"]
+    missing = fetch("/api/traces/" + "0" * 32)
+    assert "error" in missing
+
+
+def test_rtpu_trace_cli(cluster, tmp_path, capsys):
+    """`rtpu trace list` and `rtpu trace get` against the live head."""
+    from ray_tpu import scripts
+
+    @ray_tpu.remote
+    def cli_probe():
+        return 1
+
+    ray_tpu.get(cli_probe.remote(), timeout=60)
+    trace = _wait_for_trace(
+        min_spans=2,
+        predicate=lambda t: any("cli_probe" in s["name"]
+                                for s in t["spans"]))
+    host, port = ray_tpu.api._worker().head_addr
+    addr = f"{host}:{port}"
+    # big limit: serve reconcile health-checks from earlier tests in
+    # this module's shared cluster keep minting traces, so the probe's
+    # trace may not sit in the newest 20
+    assert scripts.main(["trace", "--address", addr, "list",
+                         "--limit", "500"]) == 0
+    out = capsys.readouterr().out
+    assert trace["trace_id"] in out
+    dest = str(tmp_path / "trace.json")
+    assert scripts.main(["trace", "--address", addr, "get",
+                         trace["trace_id"], "-o", dest]) == 0
+    dumped = json.load(open(dest))
+    assert dumped["trace_id"] == trace["trace_id"]
+    assert len(dumped["spans"]) >= 2
+    assert scripts.main(["trace", "--address", addr, "get",
+                         "f" * 32]) == 1
+
+
+def test_get_log_missing_filename_raises(cluster):
+    """Satellite: an explicit, nonexistent filename must raise instead
+    of silently returning some other log file."""
+    from ray_tpu.util.state import get_log
+
+    with pytest.raises(FileNotFoundError):
+        get_log(filename="no_such_file_xyz.log")
+    # default (no filename) still returns the latest log quietly
+    assert isinstance(get_log(), str)
