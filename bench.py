@@ -182,6 +182,167 @@ def bench_trace_overhead(ray_tpu, n=1500, pairs=3):
         "trace_overhead_pct": round(100.0 * (off - on) / off, 2),
     }
 
+def _serve_http_get(host, port, conns, total, path, timeout_s=120):
+    """Drive the Serve proxy with `conns` keep-alive connections issuing
+    `total` GET requests between them; returns (rps, p99_ms)."""
+    import asyncio
+
+    lat = []
+    errors = [0]
+    counter = [0]
+
+    async def client():
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+        except OSError:
+            errors[0] += 1
+            return
+        req = f"GET {path} HTTP/1.1\r\nHost: bench\r\n\r\n".encode()
+        try:
+            while counter[0] < total:
+                counter[0] += 1
+                t0 = time.perf_counter()
+                writer.write(req)
+                await writer.drain()
+                status = await reader.readline()
+                clen = 0
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    if h.lower().startswith(b"content-length:"):
+                        clen = int(h.split(b":", 1)[1])
+                if clen:
+                    await reader.readexactly(clen)
+                if b"200" in status:
+                    lat.append(time.perf_counter() - t0)
+                else:
+                    errors[0] += 1
+        except (OSError, asyncio.IncompleteReadError):
+            errors[0] += 1
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def run():
+        await asyncio.wait_for(
+            asyncio.gather(*[client() for _ in range(conns)]),
+            timeout=timeout_s)
+
+    t0 = time.perf_counter()
+    asyncio.run(run())
+    wall = time.perf_counter() - t0
+    if not lat:
+        raise RuntimeError(f"no serve responses ({errors[0]} errors)")
+    lat.sort()
+    p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))] * 1000.0
+    return len(lat) / wall, p99
+
+def _serve_sse_items(host, port, conns, rounds, path, timeout_s=120):
+    """SSE items/s: each connection issues `rounds` back-to-back
+    chunked requests on ONE keep-alive connection (exercising
+    keep-alive-after-SSE, async plane only)."""
+    import asyncio
+
+    items = [0]
+
+    async def client():
+        reader, writer = await asyncio.open_connection(host, port)
+        req = (f"GET {path} HTTP/1.1\r\nHost: bench\r\n"
+               "Accept: text/event-stream\r\n\r\n").encode()
+        try:
+            for _ in range(rounds):
+                writer.write(req)
+                await writer.drain()
+                while True:  # status + headers
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                while True:  # chunks
+                    size = int((await reader.readline()).strip() or b"0", 16)
+                    if size == 0:
+                        await reader.readline()  # trailing CRLF
+                        break
+                    await reader.readexactly(size + 2)  # data + CRLF
+                    items[0] += 1
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def run():
+        await asyncio.wait_for(
+            asyncio.gather(*[client() for _ in range(conns)]),
+            timeout=timeout_s)
+
+    t0 = time.perf_counter()
+    asyncio.run(run())
+    if not items[0]:
+        raise RuntimeError("no SSE items received")
+    return items[0] / (time.perf_counter() - t0)
+
+def bench_serve(ray_tpu, pairs=2, conns=64, total=1200):
+    """Serve data-plane phases: keep-alive HTTP RPS + p99 through the
+    proxy, async event-loop ingress vs the executor-thread baseline
+    (legacy_threads=True), measured BEST-OF ALTERNATING PAIRS per the
+    slow-box protocol.  Also: SSE streaming items/s and a 256-in-flight
+    completion check (the old thread pool capped in-flight at ~32)."""
+    from ray_tpu import serve
+
+    @serve.deployment(name="echo_bench", num_replicas=2,
+                      max_ongoing_requests=32)
+    def echo_bench(x):
+        return {"ok": 1}
+
+    @serve.deployment(name="sse_bench")
+    def sse_bench(x):
+        for i in range(25):
+            yield i
+
+    serve.run(echo_bench.bind())
+    serve.run(sse_bench.bind())
+    out = {}
+    try:
+        thread_rates, async_rates, async_p99 = [], [], []
+        for _ in range(pairs):
+            for legacy in (True, False):
+                try:
+                    serve.shutdown_http()
+                except Exception:
+                    pass
+                host, port = serve.start_http(legacy_threads=legacy)
+                _serve_http_get(host, port, 4, 40, "/echo_bench?x=1")  # warm
+                rps, p99 = _serve_http_get(host, port, conns, total,
+                                           "/echo_bench?x=1")
+                (thread_rates if legacy else async_rates).append(rps)
+                if not legacy:
+                    async_p99.append(p99)
+        out["serve_rps"] = round(max(async_rates), 1)
+        out["serve_rps_thread_baseline"] = round(max(thread_rates), 1)
+        out["serve_async_vs_threads"] = round(
+            max(async_rates) / max(thread_rates), 2)
+        out["serve_p99_ms"] = round(min(async_p99), 2)
+        # stream + high-inflight phases ride the async plane just started
+        host, port = serve.proxy_addresses()[0]
+        out["serve_stream_items_per_s"] = round(
+            _serve_sse_items(host, port, 8, 3, "/sse_bench?x=1"), 1)
+        rps256, _ = _serve_http_get(host, port, 256, 256, "/echo_bench?x=1")
+        out["serve_inflight_256_ok"] = rps256 > 0
+    finally:
+        try:
+            serve.shutdown_http()
+        except Exception:
+            pass
+        for name in ("echo_bench", "sse_bench"):
+            try:
+                serve.delete(name)
+            except Exception:
+                pass
+    return out
+
 def bench_small_ops(ray_tpu, n=1000):
     """Small-object put/get ops/s (reference: ray_perf.py:120-122,
     'single client get/put' — 10,181.6 / 5,545.0 ops/s recorded)."""
@@ -346,6 +507,11 @@ def main():
         phase("multi_client", lambda: extras.__setitem__(
             "multi_client_tasks_per_s",
             round(bench_multi_client(ray_tpu), 1)))
+        # serve phases after the task phases: a serve regression (proxy
+        # wedge, deploy failure) can never zero out the numbers above —
+        # phase() catches it and the internal asyncio drivers carry
+        # their own hard timeouts
+        phase("serve", lambda: extras.update(bench_serve(ray_tpu)))
         try:
             ray_tpu.shutdown()
         except Exception as exc:  # noqa: BLE001

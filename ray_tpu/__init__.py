@@ -15,13 +15,14 @@ from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.streaming import ObjectRefGenerator
 from ray_tpu.api import (ActorClass, ActorHandle, RemoteFunction,
                          available_resources, cancel, cluster_resources, get,
-                         get_actor, init, is_initialized, kill, method, nodes,
-                         put, remote, shutdown, wait)
+                         get_actor, get_async, init, is_initialized, kill,
+                         method, nodes, put, remote, shutdown, wait)
 
 __version__ = "0.2.0"
 
 __all__ = [
-    "init", "shutdown", "remote", "get", "put", "wait", "kill", "cancel",
+    "init", "shutdown", "remote", "get", "get_async", "put", "wait",
+    "kill", "cancel",
     "get_actor", "method", "cluster_resources", "available_resources",
     "nodes", "is_initialized", "ObjectRef", "ObjectRefGenerator",
     "ActorHandle", "ActorClass", "RemoteFunction",

@@ -63,6 +63,11 @@ _def("memory_monitor_test_usage_file", "")    # test hook: fraction in a file
 _def("task_events_buffer_size", 10_000)
 _def("metrics_report_interval_ms", 5_000)
 _def("event_stats", True)
+# --- serve data plane (see serve/http.py) ------------------------------------
+_def("serve_max_inflight_requests", 1024)  # proxy-wide gate; 503 beyond
+_def("serve_max_header_bytes", 65536)      # request line + headers cap (431)
+_def("serve_max_body_bytes", 32 * 1024 * 1024)  # request body cap (413)
+_def("serve_pipeline_depth", 32)  # pipelined requests per connection
 # --- distributed tracing (see _private/tracing.py) ---------------------------
 _def("tracing_enabled", True)
 _def("trace_sampling_ratio", 1.0)      # root-span sampling probability

@@ -18,12 +18,36 @@ Sizes (bytes):
 from __future__ import annotations
 
 import os
+import random as _random
+import threading as _threading
 
 _JOB_ID_SIZE = 4
 _ACTOR_ID_SIZE = 16
 _TASK_ID_SIZE = 24
 _OBJECT_ID_SIZE = 28
 _UNIQUE_ID_SIZE = 28
+
+_rand_lock = _threading.Lock()
+_rand_state = None  # (pid, Random)
+
+
+def _random_id_bytes(n: int) -> bytes:
+    """Process-local PRNG for ID minting.  os.urandom is a SYSCALL per
+    call — ~1 ms on syscall-throttled sandboxes, and it sat directly on
+    every task-submission hot path (one TaskID per .remote()).  IDs
+    need uniqueness, not cryptographic strength: a 128-bit-seeded PRNG
+    stream gives the same 8-byte collision behavior.  Seeded from
+    os.urandom once per process and re-seeded on pid change, so a
+    forked child can never clone the parent's stream."""
+    global _rand_state
+    pid = os.getpid()
+    with _rand_lock:
+        st = _rand_state
+        if st is None or st[0] != pid:
+            st = (pid,
+                  _random.Random(int.from_bytes(os.urandom(16), "little")))
+            _rand_state = st
+        return st[1].getrandbits(8 * n).to_bytes(n, "little")
 
 
 class BaseID:
@@ -39,7 +63,7 @@ class BaseID:
 
     @classmethod
     def from_random(cls):
-        return cls(os.urandom(cls.SIZE))
+        return cls(_random_id_bytes(cls.SIZE))
 
     @classmethod
     def from_hex(cls, hex_str: str):
@@ -107,7 +131,8 @@ class ActorID(BaseID):
 
     @classmethod
     def of(cls, job_id: JobID) -> "ActorID":
-        return cls(os.urandom(_ACTOR_ID_SIZE - _JOB_ID_SIZE) + job_id.binary())
+        return cls(_random_id_bytes(_ACTOR_ID_SIZE - _JOB_ID_SIZE)
+                   + job_id.binary())
 
     @classmethod
     def nil_for_job(cls, job_id: JobID) -> "ActorID":
@@ -123,11 +148,12 @@ class TaskID(BaseID):
 
     @classmethod
     def for_normal_task(cls, job_id: JobID) -> "TaskID":
-        return cls(os.urandom(8) + ActorID.nil_for_job(job_id).binary())
+        return cls(_random_id_bytes(8)
+                   + ActorID.nil_for_job(job_id).binary())
 
     @classmethod
     def for_actor_task(cls, actor_id: ActorID) -> "TaskID":
-        return cls(os.urandom(8) + actor_id.binary())
+        return cls(_random_id_bytes(8) + actor_id.binary())
 
     @classmethod
     def for_actor_creation(cls, actor_id: ActorID) -> "TaskID":

@@ -253,6 +253,24 @@ def _merge_families(lines: List[str]) -> List[str]:
 
 default_registry = MetricsRegistry()
 
+_serve_request_latency: Optional[Histogram] = None
+
+
+def serve_request_latency_histogram() -> Histogram:
+    """Process-singleton ``ray_tpu_serve_request_latency_seconds``:
+    proxy-side ingress latency, observed once per routed HTTP request in
+    serve/http.py (socket-in to response-ready, labeled by status code).
+    Lives here so the proxy actor's registry exports it through the
+    standard worker->node-agent push path."""
+    global _serve_request_latency
+    if _serve_request_latency is None:
+        _serve_request_latency = Histogram(
+            "ray_tpu_serve_request_latency_seconds",
+            "serve HTTP ingress request latency (proxy-side)",
+            boundaries=[0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                        0.25, 0.5, 1, 2.5, 5, 10, 60])
+    return _serve_request_latency
+
 
 async def start_metrics_http_server(registry: MetricsRegistry,
                                     host: str = "127.0.0.1",

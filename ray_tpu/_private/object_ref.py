@@ -99,11 +99,33 @@ class ObjectRef:
             except Exception:
                 pass
 
-    # Allow `await ref` once an asyncio integration lands; for now, and to
-    # fail loudly instead of silently hanging, direct iteration is blocked.
+    # Direct (sync) iteration stays blocked so a for-loop over a ref
+    # fails loudly; `await ref` resolves through the owner-loop
+    # completion path (worker.get_async) without parking a thread.
     def __iter__(self):
         raise TypeError(
             "ObjectRef is not iterable; use ray_tpu.get(ref) to fetch the value")
+
+    async def _resolve_async(self):
+        from ray_tpu._private.worker import global_worker_or_none
+
+        w = self._worker or global_worker_or_none()
+        if w is None:
+            raise RuntimeError(
+                "ray_tpu is not initialized; cannot await an ObjectRef")
+        return (await w.get_async([self]))[0]
+
+    def __await__(self):
+        return self._resolve_async().__await__()
+
+    def future(self):
+        """Schedule resolution on the running event loop; returns an
+        asyncio.Task resolving to the value (reference: ObjectRef.future
+        / as_future in the asyncio integration).  Must be called from a
+        coroutine or loop callback."""
+        import asyncio
+
+        return asyncio.ensure_future(self._resolve_async())
 
 
 def _deserialize_ref(oid: str, owner_addr, node_addr) -> ObjectRef:

@@ -43,16 +43,38 @@ STREAMING = -1  # TaskSpec.num_returns wire value for streaming tasks
 class StreamState:
     """Owner-side record of one in-flight generator task's stream."""
 
-    __slots__ = ("arrived", "total", "error", "event")
+    __slots__ = ("arrived", "total", "error", "event", "_async_waiters",
+                 "_wlock")
 
     def __init__(self):
         self.arrived = 0                 # contiguous items reported so far
         self.total: Optional[int] = None  # set when the task finishes
         self.error: Optional[BaseException] = None
         self.event = threading.Event()   # wakes blocked consumers
+        # one-shot zero-arg callbacks fired on any stream transition
+        # (item arrival, error, completion) — the async consumption path
+        # (next_ref_async) parks event-loop futures here instead of a
+        # thread on `event`
+        self._async_waiters: list = []
+        self._wlock = threading.Lock()
 
     def wake(self) -> None:
         self.event.set()
+        with self._wlock:
+            waiters, self._async_waiters = self._async_waiters, []
+        for cb in waiters:
+            try:
+                cb()
+            except Exception:
+                pass
+
+    def add_async_waiter(self, cb) -> None:
+        """Register a callback for the next wake().  Callers MUST
+        re-check stream state after registering (a wake between their
+        check and the registration is otherwise lost) — a stale callback
+        firing later is harmless, so no dedup/removal is needed."""
+        with self._wlock:
+            self._async_waiters.append(cb)
 
 
 class ObjectRefGenerator:
@@ -110,9 +132,12 @@ class ObjectRefGenerator:
                     raise StopIteration
                 s.event.clear()
                 # re-check after clear: the producer may have fired
-                # between the checks above and the clear (lost-wake guard)
+                # between the checks above and the clear (lost-wake
+                # guard).  total alone is not progress — only
+                # total-with-all-items-handed-out is (a broader check
+                # would spin when total lands before trailing items)
                 if (self._next < s.arrived or s.error is not None
-                        or s.total is not None):
+                        or (s.total is not None and self._next >= s.total)):
                     continue
                 remaining = None if deadline is None \
                     else deadline - _time.monotonic()
@@ -124,6 +149,67 @@ class ObjectRefGenerator:
         finally:
             if notify:
                 w._notify_blocked(False)
+
+    def __aiter__(self) -> "ObjectRefGenerator":
+        return self
+
+    async def __anext__(self) -> ObjectRef:
+        return await self.next_ref_async()
+
+    async def next_ref_async(self,
+                             timeout: Optional[float] = None) -> ObjectRef:
+        """Awaitable ``__next__``: resolves on the calling event loop via
+        stream-state wake callbacks — no thread parked per consumer (the
+        async Serve ingress awaits many streams on one loop).  Raises
+        StopAsyncIteration at end-of-stream and TimeoutError on timeout.
+
+        Unlike the sync path this never donates the lease's CPU
+        (worker-blocked notification): it is meant for event-loop
+        consumers (driver/proxy loops), which hold no exec lease."""
+        import asyncio
+        import time as _time
+
+        w = self._worker
+        s = w._streams.get(self._task_id)
+        if s is None:
+            raise StopAsyncIteration
+        loop = asyncio.get_running_loop()
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            if self._next < s.arrived:
+                tid = TaskID.from_hex(self._task_id)
+                oid = ObjectID.from_index(tid, self._next + 1).hex()
+                self._next += 1
+                return ObjectRef(oid, owner_addr=w.address)
+            if s.error is not None:
+                raise s.error
+            if s.total is not None and self._next >= s.total:
+                w._streams.pop(self._task_id, None)
+                raise StopAsyncIteration
+            fut = loop.create_future()
+            s.add_async_waiter(lambda: loop.call_soon_threadsafe(
+                lambda: fut.done() or fut.set_result(None)))
+            # lost-wake guard: the producer may have fired between the
+            # checks above and the registration — re-check before
+            # parking.  The total condition must include the index
+            # comparison: total-set-with-items-still-in-flight would
+            # otherwise spin here without awaiting or timing out.
+            if (self._next < s.arrived or s.error is not None
+                    or (s.total is not None and self._next >= s.total)):
+                continue
+            remaining = None if deadline is None \
+                else deadline - _time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise TimeoutError(f"no streamed item within {timeout}s")
+            try:
+                # 0.5 s recheck cap mirrors the sync path's robustness
+                # against a missed wake; the common case resolves via the
+                # callback well before it
+                await asyncio.wait_for(
+                    fut, min(0.5, remaining) if remaining is not None
+                    else 0.5)
+            except asyncio.TimeoutError:
+                pass
 
     def _should_notify(self, s: StreamState) -> bool:
         from ray_tpu._private.worker import MODE_WORKER

@@ -400,6 +400,10 @@ class CoreWorker(RpcHost):
         # executor-side coalescing buffer for batched-push results:
         # id(conn) -> (conn, [result items]) flushed once per loop tick
         self._result_bufs: Dict[int, Tuple[Any, List[Dict[str, Any]]]] = {}
+        # coalesced cross-thread posts to the IO loop (see _post_to_loop)
+        self._post_lock = threading.Lock()
+        self._post_buf: deque = deque()
+        self._post_scheduled = False
         # worker-mode execution state
         self._task_queue: "queue.Queue" = queue.Queue()
         self._actor_instance: Any = None
@@ -515,6 +519,49 @@ class CoreWorker(RpcHost):
 
     def _loop(self):
         return self._io.loop
+
+    def _post_to_loop(self, fn, *args) -> None:
+        """call_soon_threadsafe with wakeup coalescing.  Every
+        call_soon_threadsafe writes a byte to the loop's self-pipe — a
+        SYSCALL per call, ~1 ms on syscall-throttled boxes, paid on the
+        submission hot path (one per .remote(), one per exec reply).
+        Here the wakeup is written only on the buffer's empty→nonempty
+        edge; a burst of N submissions pays ONE syscall and the drain
+        callback runs them FIFO (submission order — the actor seqno
+        contract — is preserved).  Raises RuntimeError like
+        call_soon_threadsafe when the loop is shut down."""
+        with self._post_lock:
+            self._post_buf.append((fn, args))
+            if self._post_scheduled:
+                return
+            self._post_scheduled = True
+        try:
+            self._loop().call_soon_threadsafe(self._drain_posts)
+        except RuntimeError:
+            with self._post_lock:
+                self._post_scheduled = False
+            raise
+
+    def _drain_posts(self) -> None:
+        while True:
+            with self._post_lock:
+                if not self._post_buf:
+                    self._post_scheduled = False
+                    return
+                items = list(self._post_buf)
+                self._post_buf.clear()
+            for fn, args in items:
+                try:
+                    fn(*args)
+                except Exception:
+                    # one bad callback must not drop the rest, but keep
+                    # the diagnostics call_soon_threadsafe used to give
+                    import sys
+
+                    print(f"[ray_tpu] exception in posted callback "
+                          f"{getattr(fn, '__name__', fn)!r}:",
+                          file=sys.stderr)
+                    traceback.print_exc()
 
     def _spawn(self, coro):
         """Fire-and-forget a coroutine on the IO loop from any thread."""
@@ -1107,6 +1154,91 @@ class CoreWorker(RpcHost):
         except Exception:
             pass
 
+    # ------------------------------------------------------------- get_async
+
+    async def get_async(self, refs: Sequence[ObjectRef],
+                        timeout: Optional[float] = None) -> List[Any]:
+        """Awaitable get: completion futures on the CALLING event loop,
+        fed by memory-store waiters — a caller can await thousands of
+        in-flight refs without parking a thread per ref (the async Serve
+        ingress rides this).  Loop-agnostic: usable from any event loop,
+        not just the worker's IO loop.
+
+        Hot path (owned refs resolving to inline values — every serve
+        reply under max_direct_call_object_size) completes entirely on
+        the loop.  Plasma-stored or borrowed values fall back to one
+        executor-thread blocking get for just those refs — the slow path
+        is already dominated by the transfer, and reconstruction/
+        recovery semantics stay identical to get()."""
+        loop = asyncio.get_running_loop()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        waits: List[Any] = []
+        cleanups: List[Tuple[str, int]] = []
+
+        def _waker(fut):
+            return lambda: loop.call_soon_threadsafe(
+                lambda: fut.done() or fut.set_result(None))
+
+        for ref in refs:
+            oid = ref.oid
+            if not self.memory.known(oid):
+                # no memory entry to await (a local plasma put, or a
+                # borrowed ref whose owner lives elsewhere): resolved by
+                # the blocking fallback below, which long-polls/fetches
+                # with the same deadline
+                continue
+            if self.memory.ready(oid):
+                continue
+            fut = loop.create_future()
+            token = self.memory.add_waiter(oid, _waker(fut))
+            if token is not None:
+                waits.append(fut)
+                cleanups.append((oid, token))
+        try:
+            if waits:
+                remaining = (None if deadline is None
+                             else max(0.0, deadline - time.monotonic()))
+                try:
+                    await asyncio.wait_for(
+                        asyncio.gather(*waits), timeout=remaining)
+                except asyncio.TimeoutError:
+                    raise GetTimeoutError(
+                        f"timed out awaiting {len(waits)} of "
+                        f"{len(refs)} objects") from None
+        finally:
+            for oid, token in cleanups:
+                self.memory.remove_waiter(oid, token)
+        out: List[Any] = [None] * len(refs)
+        slow: List[Tuple[int, ObjectRef]] = []
+        for i, ref in enumerate(refs):
+            entry = self.memory.peek(ref.oid)
+            if entry is None or entry.in_plasma:
+                slow.append((i, ref))
+                continue
+            if entry.error is not None:
+                raise entry.error
+            value, raw = entry.value, entry.raw
+            if value is None and raw is None:
+                # raced clear (reconstruction): take the blocking path
+                slow.append((i, ref))
+                continue
+            if value is None:
+                with SerializationContext() as dctx:
+                    value = serialization.deserialize(raw)
+                    entry.value = value
+                self._register_foreign_refs(dctx.refs)
+            out[i] = value
+        if slow:
+            # the ABSOLUTE deadline rides into the executor job: deriving
+            # it at job start would let executor queue wait silently
+            # extend the caller's timeout
+            slow_refs = [r for _, r in slow]
+            values = await loop.run_in_executor(
+                None, lambda: self._get_inner(slow_refs, deadline))
+            for (i, _), v in zip(slow, values):
+                out[i] = v
+        return out
+
     # ------------------------------------------------------------------ wait
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
@@ -1283,9 +1415,9 @@ class CoreWorker(RpcHost):
         else:
             # no ref args: nothing to resolve — skip the coroutine
             # machinery (run_coroutine_threadsafe allocates a Task per
-            # call; call_soon_threadsafe is ~5x cheaper on the hot path)
+            # call; a coalesced post is ~5x cheaper on the hot path)
             try:
-                self._loop().call_soon_threadsafe(self._enqueue_ready, task)
+                self._post_to_loop(self._enqueue_ready, task)
             except RuntimeError:
                 pass  # loop shut down
         if span is not None:
@@ -2063,8 +2195,7 @@ class CoreWorker(RpcHost):
             self.memory.ensure(oid)
             refs.append(ObjectRef(oid, owner_addr=self.address))
         try:
-            self._loop().call_soon_threadsafe(self._actor_enqueue,
-                                              astate, task)
+            self._post_to_loop(self._actor_enqueue, astate, task)
         except RuntimeError:
             pass  # loop shut down
         return refs
@@ -2506,8 +2637,12 @@ class CoreWorker(RpcHost):
         return self._error_reply(spec, e, tb)
 
     def _post_exec_reply(self, fut, reply) -> None:
-        self._loop().call_soon_threadsafe(
-            lambda f=fut, r=reply: (not f.done()) and f.set_result(r))
+        self._post_to_loop(self._set_exec_result, fut, reply)
+
+    @staticmethod
+    def _set_exec_result(fut, reply) -> None:
+        if not fut.done():
+            fut.set_result(reply)
 
     def _start_concurrency_threads(self, n: int):
         """Extra executors for actors with max_concurrency > 1
@@ -2726,10 +2861,14 @@ class CoreWorker(RpcHost):
                     wire = {"stored": {"oid": oid,
                                        "node": list(self.agent_addr)}}
                 if conn is not None:
-                    # ordered: call_soon_threadsafe enqueues FIFO and each
-                    # push writes its frame in the coroutine's first step,
-                    # so items and the final reply arrive in order
-                    loop.call_soon_threadsafe(
+                    # ordered: item posts and the final reply post (see
+                    # _post_exec_reply) ride the SAME coalesced FIFO
+                    # buffer, and each push writes its frame in the
+                    # coroutine's first step — so items and the reply
+                    # arrive in order (a mixed direct/coalesced scheme
+                    # could let an already-queued drain resolve the
+                    # reply ahead of a still-queued item callback)
+                    self._post_to_loop(
                         _aio.ensure_future,
                         conn.push("stream_item", {
                             "task_id": spec.task_id, "index": n,

@@ -167,6 +167,19 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
     return w.get(list(refs), timeout=timeout)
 
 
+async def get_async(refs: Union[ObjectRef, Sequence[ObjectRef]],
+                    *, timeout: Optional[float] = None) -> Any:
+    """Awaitable ray_tpu.get: resolves on the calling event loop via
+    owner-side completion futures — no thread blocked per caller, so an
+    event-loop server (the async Serve ingress) can await thousands of
+    refs concurrently.  ``await ref`` and ``ref.future()`` are sugar
+    over the same path."""
+    w = _worker()
+    if isinstance(refs, ObjectRef):
+        return (await w.get_async([refs], timeout=timeout))[0]
+    return await w.get_async(list(refs), timeout=timeout)
+
+
 def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
          timeout: Optional[float] = None
          ) -> Tuple[List[ObjectRef], List[ObjectRef]]:

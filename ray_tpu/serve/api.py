@@ -743,6 +743,35 @@ class _SharedWaiter:
 _shared_waiter = _SharedWaiter()
 
 
+def _watch_ref_done(ref, cb) -> None:
+    """Fire ``cb`` once `ref` resolves (value OR error), releasing a
+    handle's inflight charge.
+
+    Fast path for refs owned by this process (every handle call — the
+    submit happens locally): ONE memory-store waiter, fired on the IO
+    thread at resolution, O(1) per request.  The closure pins the ref so
+    the entry cannot be evicted (and the callback lost) if the caller
+    abandons the ref mid-flight.  The shared waiter's wait()-polling
+    loop — which re-registers EVERY in-flight ref on each round and eats
+    the GIL under high concurrency — is kept only as the fallback for
+    refs owned elsewhere."""
+    from ray_tpu._private.worker import global_worker_or_none
+
+    w = global_worker_or_none()
+    if (w is not None and ref.owner_addr is not None
+            and tuple(ref.owner_addr) == w.address):
+        pin = [ref]
+
+        def _fire():
+            pin.clear()
+            cb()
+
+        if w.memory.add_waiter(ref.oid, _fire) is None:
+            cb()  # already resolved
+        return
+    _shared_waiter.watch(ref, cb)
+
+
 class _MetricsPusher:
     """ONE daemon thread pushing windowed-average ongoing requests for
     every live handle (reference: serve/_private/metrics_utils.py
@@ -864,6 +893,29 @@ class DeploymentHandle:
             # cached replica set (detached actors, still alive) keeps
             # serving — a failed refresh must not fail the request
             return
+        self._apply_refresh(info)
+
+    async def _refresh_async(self, force: bool = False):
+        """Awaitable replica-list refresh for event-loop callers: the
+        controller reply is awaited via get_async instead of blocking
+        the loop's thread.  (_controller() itself still does one sync
+        name-resolution RPC — sub-ms, once per refresh period.)"""
+        now = time.monotonic()
+        if not force and now - self._last_refresh < self.REFRESH_PERIOD_S:
+            return
+        import ray_tpu
+
+        self._last_refresh = now
+        try:
+            ctrl = _controller()
+            info = await ray_tpu.get_async(
+                ctrl.get_replicas.remote(self._name, self._version),
+                timeout=30)
+        except Exception:
+            return  # best-effort, same as the sync path
+        self._apply_refresh(info)
+
+    def _apply_refresh(self, info) -> None:
         if info is None or info.get("unchanged"):
             return
         if info["version"] != self._version:
@@ -874,12 +926,11 @@ class DeploymentHandle:
                 self._set_replicas(info["replica_ids"],
                                    info.get("replica_nodes"))
 
-    def remote(self, *args, _method: str = "__call__", **kwargs):
+    def _pick_replica(self, local_pref: bool = True):
+        """Choose a replica (least-outstanding-requests) and charge it
+        +1 inflight; returns (replica, rid)."""
         import random
 
-        self._maybe_refresh()
-        if not self._replicas:
-            self._maybe_refresh(force=True)
         with self._lock:
             if not self._replicas:
                 raise RuntimeError(
@@ -893,7 +944,8 @@ class DeploymentHandle:
                      if self._replica_nodes.get(r._actor_id)
                      == self._my_node
                      and self._inflight.get(r._actor_id, 0)
-                     < self._max_ongoing] if self._my_node else []
+                     < self._max_ongoing] \
+                if (local_pref and self._my_node) else []
             pool = local or self._replicas
             if len(pool) > 2:
                 pool = random.sample(pool, 2)
@@ -901,6 +953,12 @@ class DeploymentHandle:
                           key=lambda r: self._inflight.get(r._actor_id, 0))
             rid = replica._actor_id
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
+        return replica, rid
+
+    def _submit_call(self, replica, rid: str, _method: str, args, kwargs):
+        """Submit one replica call (non-blocking) under a handle-call
+        span; registers the completion watcher that releases the
+        inflight charge.  Shared by remote() and remote_async()."""
         # handle-call span: ties a Serve request (HTTP ingress span or an
         # in-cluster caller's active trace) to the replica-side actor
         # task — the submit/execute spans chain under it automatically
@@ -923,30 +981,31 @@ class DeploymentHandle:
                 if rid in self._inflight:
                     self._inflight[rid] -= 1
 
-        _shared_waiter.watch(ref, _done_cb)
+        _watch_ref_done(ref, _done_cb)
         return ref
 
-    def stream(self, *args, _method: str = "__call__", **kwargs):
-        """Call a generator endpoint; yields one ObjectRef per item as
-        the replica produces them (reference: DeploymentResponseGenerator
-        in serve/handle.py).  Token streaming for TPU inference rides
-        this: the replica yields tokens, callers consume mid-generation."""
-        import random
-
+    def remote(self, *args, _method: str = "__call__", **kwargs):
         self._maybe_refresh()
         if not self._replicas:
             self._maybe_refresh(force=True)
-        with self._lock:
-            if not self._replicas:
-                raise RuntimeError(
-                    f"deployment {self._name!r} has no replicas")
-            pool = self._replicas
-            if len(pool) > 2:
-                pool = random.sample(pool, 2)
-            replica = min(pool,
-                          key=lambda r: self._inflight.get(r._actor_id, 0))
-            rid = replica._actor_id
-            self._inflight[rid] = self._inflight.get(rid, 0) + 1
+        replica, rid = self._pick_replica()
+        return self._submit_call(replica, rid, _method, args, kwargs)
+
+    async def remote_async(self, *args, _method: str = "__call__", **kwargs):
+        """Async-native remote(): same least-outstanding-requests
+        replica choice and inflight accounting, but the periodic
+        controller refresh is awaited on the calling loop instead of
+        blocking a thread.  Returns the ObjectRef — ``await ref`` (or
+        ``ray_tpu.get_async``) for the value.  The async Serve ingress
+        routes every request through this."""
+        await self._refresh_async()
+        if not self._replicas:
+            await self._refresh_async(force=True)
+        replica, rid = self._pick_replica()
+        return self._submit_call(replica, rid, _method, args, kwargs)
+
+    def _submit_stream(self, replica, rid: str, _method: str, args, kwargs):
+        """Submit one streaming replica call; returns (gen, release)."""
         from ray_tpu._private import tracing
 
         span = tracing.start_span(f"serve.stream {self._name}",
@@ -979,6 +1038,19 @@ class DeploymentHandle:
         # when the replica-side task finishes producing (or errors), no
         # matter what the consumer does.
         _shared_waiter.watch_gen(gen, _release)
+        return gen, _release
+
+    def stream(self, *args, _method: str = "__call__", **kwargs):
+        """Call a generator endpoint; yields one ObjectRef per item as
+        the replica produces them (reference: DeploymentResponseGenerator
+        in serve/handle.py).  Token streaming for TPU inference rides
+        this: the replica yields tokens, callers consume mid-generation."""
+        self._maybe_refresh()
+        if not self._replicas:
+            self._maybe_refresh(force=True)
+        replica, rid = self._pick_replica(local_pref=False)
+        gen, _release = self._submit_stream(replica, rid, _method, args,
+                                            kwargs)
 
         def _wrapped():
             try:
@@ -987,6 +1059,29 @@ class DeploymentHandle:
                 _release()
 
         return _wrapped()
+
+    async def stream_async(self, *args, _method: str = "__call__", **kwargs):
+        """Async stream(): returns an async iterator of per-item
+        ObjectRefs, item arrival awaited on the calling loop (no thread
+        parked per stream).  The replica call is submitted EAGERLY in
+        the caller's context — an active ingress span parents the
+        serve.stream span, and an abandoned (never-iterated) stream
+        still releases its inflight charge via the shared waiter."""
+        await self._refresh_async()
+        if not self._replicas:
+            await self._refresh_async(force=True)
+        replica, rid = self._pick_replica(local_pref=False)
+        gen, _release = self._submit_stream(replica, rid, _method, args,
+                                            kwargs)
+
+        async def _aiter():
+            try:
+                async for ref in gen:
+                    yield ref
+            finally:
+                _release()
+
+        return _aiter()
 
     def method(self, name: str):
         def call(*args, **kwargs):

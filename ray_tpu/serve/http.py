@@ -9,6 +9,17 @@ DeploymentHandle, so they get the same least-outstanding-requests
 balancing, replica refresh, and autoscaling metrics as in-cluster
 callers.
 
+The data plane is ASYNC END TO END: every request runs as one coroutine
+on the proxy event loop — handle routing via ``remote_async`` /
+``stream_async`` and value resolution via awaitable object refs
+(``worker.get_async``), so in-flight capacity is bounded by the
+configurable shed gate (503 beyond ``serve_max_inflight_requests``),
+not by an executor thread pool.  Trace context rides contextvars (one
+asyncio task per request isolates them); connections are keep-alive
+with HTTP/1.1 pipelining, and chunked/SSE responses leave the
+connection open.  The pre-async executor-thread dispatch survives as
+``legacy_threads=True`` purely as the bench baseline for serve_rps.
+
 Routing convention:
   GET  /<name>            -> callable invoked with the query dict ({} if none)
   POST /<name>  (json)    -> callable invoked with the parsed JSON body
@@ -27,15 +38,69 @@ from urllib.parse import parse_qsl, urlsplit
 
 PROXY_NAME = "_serve_http_proxy"
 
+# sentinel first element of a _read_request error result
+_PARSE_ERR = "_err"
+
+
+class _GateCharge:
+    """Once-only holder of one admission-gate slot.  Released by the
+    gated stream's finally on any consumed path; the __del__ fallback
+    covers a stream dropped before its first iteration — an unstarted
+    async generator's finally never runs, so GC of the wrapper (which
+    pins this object in its closure) is the only signal left."""
+
+    __slots__ = ("_proxy", "_lock", "_released")
+
+    def __init__(self, proxy):
+        self._proxy = proxy
+        self._lock = threading.Lock()
+        self._released = False
+
+    def release(self) -> None:
+        with self._lock:
+            if self._released:
+                return
+            self._released = True
+        proxy = self._proxy
+
+        def dec():
+            proxy._inflight -= 1
+
+        try:
+            if threading.get_ident() == proxy._loop_thread_ident:
+                dec()
+            else:
+                proxy._loop.call_soon_threadsafe(dec)
+        except RuntimeError:
+            pass  # loop closed: the proxy is going away
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass
+
 
 class _HttpProxy:
     """Actor wrapping the asyncio HTTP server (one per ingress port)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_inflight: Optional[int] = None,
+                 legacy_threads: bool = False):
         import asyncio
 
+        from ray_tpu._private.config import config
+        from ray_tpu._private.metrics import serve_request_latency_histogram
+
         self._handles: Dict[str, Any] = {}
+        self._legacy = legacy_threads
+        self._max_inflight = int(
+            max_inflight if max_inflight is not None
+            else config.serve_max_inflight_requests)
+        self._inflight = 0  # loop-confined: touched only on the proxy loop
+        self._latency = serve_request_latency_histogram()
         self._loop = asyncio.new_event_loop()
+        self._loop_thread_ident = None  # set by the serve thread
         self._started = threading.Event()
         self._addr: Optional[tuple] = None
         self._thread = threading.Thread(
@@ -47,10 +112,18 @@ class _HttpProxy:
     def _serve_forever(self, host: str, port: int):
         import asyncio
 
+        self._loop_thread_ident = threading.get_ident()
         asyncio.set_event_loop(self._loop)
 
+        from ray_tpu._private.config import config
+
+        # stream buffer comfortably above the header cap so the 431
+        # path (not a raw ValueError from readline) handles long lines
+        limit = max(2 ** 16, 2 * int(config.serve_max_header_bytes))
+
         async def _start():
-            server = await asyncio.start_server(self._client, host, port)
+            server = await asyncio.start_server(self._client, host, port,
+                                                limit=limit)
             self._addr = server.sockets[0].getsockname()[:2]
             self._started.set()
             return server
@@ -67,93 +140,289 @@ class _HttpProxy:
     def health(self):
         return True
 
-    # ---- request handling --------------------------------------------------
+    # ---- connection handling ----------------------------------------------
 
     async def _client(self, reader, writer):
+        """Per-connection driver: a parse loop feeds an ordered queue of
+        response slots consumed by one writer coroutine — request N+1 is
+        parsed and ROUTED while N is still executing (HTTP/1.1
+        pipelining), responses always leave in request order.  The
+        bounded queue is the per-connection pipelining backpressure."""
+        import asyncio
+
+        from ray_tpu._private.config import config
+
+        slots: "asyncio.Queue" = asyncio.Queue(
+            maxsize=max(1, int(config.serve_pipeline_depth)))
+        wtask = asyncio.ensure_future(self._response_writer(slots, writer))
+        tasks = []
         try:
-            while True:
-                line = await reader.readline()
-                if not line or line in (b"\r\n", b"\n"):
+            while not wtask.done():
+                req = await self._read_request(reader)
+                if req is None:
+                    break  # clean EOF / client went away
+                if req[0] is _PARSE_ERR:
+                    # framing is untrustworthy after a parse error:
+                    # respond and close
+                    slot = asyncio.get_running_loop().create_future()
+                    slot.set_result((req[1], req[2], None, False))
+                    await self._put_slot(slots, slot, wtask)
                     break
-                try:
-                    method, target, _ = line.decode("latin1").split(" ", 2)
-                except ValueError:
-                    break
-                headers: Dict[str, str] = {}
-                while True:
-                    h = await reader.readline()
-                    if not h or h in (b"\r\n", b"\n"):
-                        break
-                    k, _, v = h.decode("latin1").partition(":")
-                    headers[k.strip().lower()] = v.strip()
-                body = b""
-                length = int(headers.get("content-length", 0) or 0)
-                if length:
-                    body = await reader.readexactly(length)
-                status, payload, stream = await self._route(method, target,
-                                                            headers, body)
-                keep = headers.get("connection", "keep-alive") != "close"
-                if stream is not None:
-                    await self._write_chunked(writer, stream)
-                    break  # chunked responses close the connection
-                writer.write(
-                    b"HTTP/1.1 " + status.encode() + b"\r\n"
-                    b"Content-Type: application/json\r\n"
-                    b"Content-Length: " + str(len(payload)).encode() + b"\r\n"
-                    b"Connection: " + (b"keep-alive" if keep else b"close") +
-                    b"\r\n\r\n" + payload)
-                await writer.drain()
+                method, target, headers, body, keep = req
+                slot = asyncio.get_running_loop().create_future()
+                if not await self._put_slot(slots, slot, wtask):
+                    break  # writer died with the queue full: tear down
+                tasks.append(asyncio.ensure_future(self._handle_request(
+                    method, target, headers, body, keep, slot)))
+                tasks = [t for t in tasks if not t.done()]
                 if not keep:
-                    break
-        except (ConnectionError, TimeoutError) as e:
+                    break  # last request on this connection
+            # end-of-responses sentinel
+            await self._put_slot(slots, None, wtask)
+            try:
+                await wtask
+            except Exception:
+                pass
+        except (ConnectionError, TimeoutError):
             pass  # peer went away: normal
+        except asyncio.IncompleteReadError:
+            pass
         except Exception as e:
-            import asyncio
             import sys
 
-            if not isinstance(e, asyncio.IncompleteReadError):
-                print(f"[serve.http] connection handler error: "
-                      f"{type(e).__name__}: {e}", file=sys.stderr)
+            print(f"[serve.http] connection handler error: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
         finally:
+            wtask.cancel()
+            for t in tasks:
+                t.cancel()
             try:
                 writer.close()
             except Exception:
                 pass
 
+    @staticmethod
+    async def _put_slot(slots, slot, wtask) -> bool:
+        """Enqueue a response slot, raced against writer-task exit: a
+        full pipeline queue with a dead writer (peer reset mid-burst)
+        must never park the connection coroutine forever.  Returns False
+        when the writer is gone."""
+        import asyncio
+
+        put = asyncio.ensure_future(slots.put(slot))
+        await asyncio.wait({put, wtask},
+                           return_when=asyncio.FIRST_COMPLETED)
+        if put.done():
+            return True
+        put.cancel()
+        return False
+
+    async def _read_request(self, reader):
+        """Parse one request.  Returns (method, target, headers, body,
+        keep), an (``_PARSE_ERR``, status, payload) triple for requests
+        answered with an error + close, or None on EOF.
+
+        Defensive by design (one misbehaving client must not take the
+        proxy down): malformed Content-Length -> 400, header bytes
+        beyond serve_max_header_bytes -> 431, bodies beyond
+        serve_max_body_bytes -> 413.  HTTP/1.0 is close-by-default —
+        keep-alive only on explicit opt-in."""
+        import asyncio
+
+        from ray_tpu._private.config import config
+
+        max_head = int(config.serve_max_header_bytes)
+        try:
+            while True:  # tolerate stray blank lines between requests
+                line = await reader.readline()
+                if not line:
+                    return None
+                if line not in (b"\r\n", b"\n"):
+                    break
+            if len(line) > max_head:
+                return (_PARSE_ERR, "431 Request Header Fields Too Large",
+                        b'{"error": "request line too long"}')
+            try:
+                method, target, version = line.decode("latin1").split(" ", 2)
+            except ValueError:
+                return (_PARSE_ERR, "400 Bad Request",
+                        b'{"error": "malformed request line"}')
+            headers: Dict[str, str] = {}
+            total = len(line)
+            while True:
+                h = await reader.readline()
+                if not h:
+                    return None  # EOF mid-headers
+                if h in (b"\r\n", b"\n"):
+                    break
+                total += len(h)
+                if total > max_head:
+                    return (_PARSE_ERR,
+                            "431 Request Header Fields Too Large",
+                            b'{"error": "headers too large"}')
+                k, _, v = h.decode("latin1").partition(":")
+                headers[k.strip().lower()] = v.strip()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            return None
+        except ValueError:
+            # readline overran the stream buffer: a single header line
+            # beyond even the raised limit
+            return (_PARSE_ERR, "431 Request Header Fields Too Large",
+                    b'{"error": "header line too long"}')
+        http10 = version.strip().upper().startswith("HTTP/1.0")
+        conn = headers.get("connection", "").lower()
+        keep = (conn == "keep-alive") if http10 else (conn != "close")
+        te = headers.get("transfer-encoding", "").lower()
+        if te and te != "identity":
+            # a chunked body we don't de-frame would be re-parsed as
+            # pipelined requests — the classic smuggling vector; refuse
+            # instead of desyncing
+            return (_PARSE_ERR, "501 Not Implemented",
+                    b'{"error": "transfer-encoding not supported"}')
+        body = b""
+        cl = headers.get("content-length")
+        if cl:
+            try:
+                length = int(cl)
+                if length < 0:
+                    raise ValueError(cl)
+            except ValueError:
+                return (_PARSE_ERR, "400 Bad Request",
+                        b'{"error": "invalid content-length"}')
+            if length > int(config.serve_max_body_bytes):
+                return (_PARSE_ERR, "413 Payload Too Large",
+                        b'{"error": "request body too large"}')
+            if length:
+                try:
+                    body = await reader.readexactly(length)
+                except (ConnectionError, asyncio.IncompleteReadError):
+                    return None
+        return method, target, headers, body, keep
+
+    async def _handle_request(self, method, target, headers, body, keep,
+                              slot):
+        import asyncio
+
+        try:
+            status, payload, stream = await self._route(method, target,
+                                                        headers, body)
+        except asyncio.CancelledError:
+            # connection teardown cancelled us: wake a writer parked on
+            # this slot, then stay cancelled (never fabricate a 500)
+            if not slot.done():
+                slot.cancel()
+            raise
+        except Exception as e:
+            status, payload, stream = (
+                "500 Internal Server Error",
+                json.dumps({"error": f"{type(e).__name__}: {e}"}).encode(),
+                None)
+        if not slot.done():
+            slot.set_result((status, payload, stream, keep))
+
+    async def _response_writer(self, slots, writer):
+        """Drain response slots in request order (the pipelining
+        contract), writing chunked/SSE responses item by item.  The
+        connection stays alive after a chunked response — its framing
+        is self-terminating (``0\\r\\n\\r\\n``)."""
+        while True:
+            slot = await slots.get()
+            if slot is None:
+                return
+            status, payload, stream, keep = await slot
+            if stream is not None:
+                if hasattr(stream, "__anext__"):
+                    await self._write_chunked(writer, stream, keep)
+                else:
+                    # legacy baseline: blocking generator, force-close
+                    await self._write_chunked_legacy(writer, stream)
+                    return
+            else:
+                writer.write(
+                    b"HTTP/1.1 " + status.encode() + b"\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"Content-Length: " + str(len(payload)).encode() +
+                    b"\r\n"
+                    b"Connection: " + (b"keep-alive" if keep else b"close") +
+                    b"\r\n\r\n" + payload)
+                await writer.drain()
+            if not keep:
+                return
+
+    # ---- routing ----------------------------------------------------------
+
     async def _route(self, method: str, target: str, headers, body: bytes):
-        """Tracing wrapper around the actual routing: an inbound W3C
-        ``traceparent`` header continues the external caller's trace
-        (reference: serve's OTel middleware); a malformed header is
-        ignored — the request proceeds untraced-from-outside but still
-        starts its own sampled root.  The ingress span context is handed
-        to the executor-thread handle call explicitly because
-        run_in_executor does not carry contextvars."""
+        """Tracing + admission wrapper around the actual routing: an
+        inbound W3C ``traceparent`` header continues the external
+        caller's trace (reference: serve's OTel middleware); a malformed
+        header is ignored — the request proceeds untraced-from-outside
+        but still starts its own sampled root.  The span context is
+        activated on the request's contextvars (each request is its own
+        asyncio task, so contexts are isolated) and flows through
+        remote_async/stream_async into the replica spans.
+
+        Admission: beyond ``serve_max_inflight_requests`` concurrently
+        routed requests the proxy sheds load with 503 instead of
+        queueing — memory stays bounded and the caller gets an
+        actionable signal (health checks bypass the gate)."""
         from ray_tpu._private import tracing
 
         path = urlsplit(target).path
         if path.strip("/") == "-/healthz":
-            return await self._route_inner(method, target, headers, body,
-                                           None)
+            return await self._route_inner(method, target, headers, body)
+        if not self._legacy and self._inflight >= self._max_inflight:
+            self._latency.observe(0.0, tags={"code": "503"})
+            return ("503 Service Unavailable",
+                    b'{"error": "proxy overloaded, try again"}', None)
+        self._inflight += 1
+        stream = None
+        t0 = time.perf_counter()
         span = tracing.start_span(
             f"http {method} {path}", kind=tracing.KIND_SERVER,
             parent=tracing.parse_traceparent(headers.get("traceparent")))
-        if span is None:
-            return await self._route_inner(method, target, headers, body,
-                                           None)
+        token = tracing.activate(span.context()) if span else None
         try:
             status, payload, stream = await self._route_inner(
-                method, target, headers, body, span.context())
+                method, target, headers, body)
         except BaseException as e:
-            span.end(error=f"{type(e).__name__}: {e}")
+            if span is not None:
+                span.end(error=f"{type(e).__name__}: {e}")
             raise
-        span.set_attribute("http.status", status.split(" ", 1)[0])
-        span.end(error="" if status.startswith("2") else status)
+        finally:
+            if stream is not None and hasattr(stream, "__anext__"):
+                # a live stream keeps its in-flight charge until it
+                # finishes — otherwise long-lived SSE streams would
+                # escape the shed gate microseconds after admission
+                stream = self._gated_stream(stream, _GateCharge(self))
+            else:
+                self._inflight -= 1
+            if token is not None:
+                tracing.restore(token)
+        self._latency.observe(time.perf_counter() - t0,
+                              tags={"code": status.split(" ", 1)[0]})
+        if span is not None:
+            span.set_attribute("http.status", status.split(" ", 1)[0])
+            span.end(error="" if status.startswith("2") else status)
         return status, payload, stream
 
-    async def _route_inner(self, method: str, target: str, headers,
-                           body: bytes, trace_ctx):
-        import asyncio
+    @staticmethod
+    def _gated_stream(agen, charge: _GateCharge):
+        """Pass stream items through; the charge releases when the
+        stream ends (exhausted, errored, generator finalized) — and,
+        because the unstarted wrapper pins `charge` in its closure, via
+        _GateCharge.__del__ if the stream is dropped before its first
+        iteration (where no finally could ever run)."""
+        async def _gen():
+            try:
+                async for item in agen:
+                    yield item
+            finally:
+                charge.release()
 
+        return _gen()
+
+    async def _route_inner(self, method: str, target: str, headers,
+                           body: bytes):
         parts = urlsplit(target)
         path = parts.path.strip("/")
         if path == "-/healthz":
@@ -175,6 +444,155 @@ class _HttpProxy:
         # into a chunked response fed by the replica's generator
         want_stream = headers.get("accept", "").startswith(
             "text/event-stream")
+        if self._legacy:
+            return await self._route_legacy(path, arg, want_stream)
+        try:
+            if want_stream:
+                gen = await self._stream_async_values(path, arg)
+                return "200 OK", b"", gen
+            result = await self._call_async(path, arg)
+        except KeyError:
+            return "404 Not Found", json.dumps(
+                {"error": f"no deployment named {path!r}"}).encode(), None
+        except Exception as e:
+            return "500 Internal Server Error", json.dumps(
+                {"error": f"{type(e).__name__}: {e}"}).encode(), None
+        try:
+            payload = json.dumps(result).encode()
+        except TypeError:
+            payload = json.dumps(str(result)).encode()
+        return "200 OK", payload, None
+
+    async def _call_async(self, name: str, arg: Any):
+        """The hot path: submit via remote_async, await the reply ref on
+        this loop — no executor thread anywhere.  A stale cached handle
+        (replicas replaced wholesale) refreshes once, like the sync
+        path always did."""
+        import ray_tpu
+
+        handle = await self._resolve_handle_async(name)
+        try:
+            ref = await handle.remote_async(arg)
+            return await ray_tpu.get_async(ref, timeout=120)
+        except ray_tpu.RayError:
+            handle = await self._resolve_handle_async(name, fresh=True)
+            ref = await handle.remote_async(arg)
+            return await ray_tpu.get_async(ref, timeout=120)
+
+    async def _stream_async_values(self, name: str, arg: Any):
+        """Async iterator of ITEM VALUES for an SSE response.  The
+        replica call is submitted EAGERLY, here in the route coroutine
+        — the ingress span is still active, so the serve.stream span
+        parents correctly (the returned generator first runs later, in
+        the writer task's context).  A stale cached handle refreshes
+        once — safe to restart the stream only before any item was
+        consumed."""
+        import ray_tpu
+
+        handle = await self._resolve_handle_async(name)
+        agen = await handle.stream_async(arg)
+
+        async def _values():
+            nonlocal handle, agen
+            yielded = retried = False
+            while True:
+                try:
+                    try:
+                        ref = await agen.__anext__()
+                    except StopAsyncIteration:
+                        return
+                    value = await ray_tpu.get_async(ref, timeout=120)
+                except ray_tpu.RayError:
+                    if yielded or retried:
+                        raise  # mid-stream death: cannot restart
+                    retried = True
+                    handle = await self._resolve_handle_async(name,
+                                                              fresh=True)
+                    agen = await handle.stream_async(arg)
+                    continue
+                yielded = True
+                yield value
+
+        return _values()
+
+    async def _write_chunked(self, writer, agen, keep: bool):
+        """One HTTP/1.1 chunk per streamed item (JSON + newline), pulled
+        off the async value iterator on this loop.  Chunked framing is
+        self-terminating, so the connection stays alive afterwards."""
+        try:
+            writer.write(b"HTTP/1.1 200 OK\r\n"
+                         b"Content-Type: text/event-stream\r\n"
+                         b"Transfer-Encoding: chunked\r\n"
+                         b"Connection: " +
+                         (b"keep-alive" if keep else b"close") +
+                         b"\r\n\r\n")
+            await writer.drain()
+            try:
+                async for item in agen:
+                    try:
+                        data = json.dumps(item).encode() + b"\n"
+                    except TypeError:
+                        data = json.dumps(str(item)).encode() + b"\n"
+                    writer.write(hex(len(data))[2:].encode() + b"\r\n"
+                                 + data + b"\r\n")
+                    await writer.drain()
+            except Exception as e:
+                data = json.dumps(
+                    {"error": f"{type(e).__name__}: {e}"}).encode()
+                writer.write(hex(len(data))[2:].encode() + b"\r\n"
+                             + data + b"\r\n")
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
+        finally:
+            # explicit close, not GC: a peer that vanished mid-stream
+            # must release the admission-gate charge NOW (asyncgen
+            # finalization can sit behind a traceback cycle until a
+            # full GC pass)
+            try:
+                await agen.aclose()
+            except Exception:
+                pass
+
+    # ---- handle cache -----------------------------------------------------
+
+    async def _resolve_handle_async(self, name: str, fresh: bool = False):
+        """Cached-handle lookup (the hot path: one dict read).  A cache
+        miss resolves through the controller on an executor thread —
+        explicitly NOT the request hot path (first request per
+        deployment, or a post-RayError refresh)."""
+        import asyncio
+
+        if not fresh and name in self._handles:
+            return self._handles[name]
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, self._resolve_handle, name, fresh)
+
+    def _resolve_handle(self, name: str, fresh: bool = False):
+        from ray_tpu.serve import api as serve_api
+
+        if fresh:
+            self._handles.pop(name, None)
+        handle = self._handles.get(name)
+        if handle is None:
+            try:
+                handle = serve_api.get_handle(name)
+            except ValueError:
+                raise KeyError(name)
+            self._handles[name] = handle
+        return handle
+
+    # ---- legacy executor-thread dispatch (bench baseline only) -------------
+
+    async def _route_legacy(self, path: str, arg: Any, want_stream: bool):
+        """The pre-async data plane, kept verbatim as the measurable
+        baseline for bench.py's serve_rps comparison: two thread hops
+        per request, concurrency capped by the executor pool."""
+        import asyncio
+
+        from ray_tpu._private import tracing
+
+        trace_ctx = tracing.current_context()
         loop = asyncio.get_running_loop()
         try:
             if want_stream:
@@ -195,9 +613,10 @@ class _HttpProxy:
             payload = json.dumps(str(result)).encode()
         return "200 OK", payload, None
 
-    async def _write_chunked(self, writer, gen):
-        """Write one HTTP/1.1 chunk per streamed item (JSON + newline),
-        pulling items off the blocking generator in the executor."""
+    async def _write_chunked_legacy(self, writer, gen):
+        """Chunk writer for the legacy blocking generator: items pulled
+        in the executor; the connection closes afterwards (the old
+        force-close behavior, preserved for baseline fidelity)."""
         import asyncio
 
         loop = asyncio.get_running_loop()
@@ -274,20 +693,6 @@ class _HttpProxy:
 
         return _values()
 
-    def _resolve_handle(self, name: str, fresh: bool = False):
-        from ray_tpu.serve import api as serve_api
-
-        if fresh:
-            self._handles.pop(name, None)
-        handle = self._handles.get(name)
-        if handle is None:
-            try:
-                handle = serve_api.get_handle(name)
-            except ValueError:
-                raise KeyError(name)
-            self._handles[name] = handle
-        return handle
-
     def _call_blocking(self, name: str, arg: Any, trace_ctx=None):
         import ray_tpu
 
@@ -308,7 +713,9 @@ def _proxy_name(node_id: str) -> str:
     return f"{PROXY_NAME}:{node_id[:12]}"
 
 
-def start_http(host: str = "127.0.0.1", port: int = 0):
+def start_http(host: str = "127.0.0.1", port: int = 0,
+               max_inflight: Optional[int] = None,
+               legacy_threads: bool = False):
     """Start (or fetch) the primary HTTP ingress; returns (host, port).
 
     One proxy per node (reference: _private/proxy.py runs per-node
@@ -317,14 +724,23 @@ def start_http(host: str = "127.0.0.1", port: int = 0):
     any node and route to replicas anywhere with locality-aware
     balancing.  Returns the primary (first node) proxy's address; use
     `proxy_addresses()` for all of them.
+
+    ``max_inflight`` overrides the serve_max_inflight_requests shed
+    gate; ``legacy_threads`` starts the executor-thread baseline data
+    plane (bench comparisons only).  Both apply only to proxies CREATED
+    by this call — an already-running proxy keeps its settings (use
+    shutdown_http() first to change them).
     """
-    addrs = start_per_node_http(host, port)
+    addrs = start_per_node_http(host, port, max_inflight=max_inflight,
+                                legacy_threads=legacy_threads)
     if not addrs:
         raise RuntimeError("HTTP proxy failed to bind")
     return addrs[0]
 
 
-def start_per_node_http(host: str = "127.0.0.1", port: int = 0):
+def start_per_node_http(host: str = "127.0.0.1", port: int = 0,
+                        max_inflight: Optional[int] = None,
+                        legacy_threads: bool = False):
     """Ensure a proxy on every node; returns [(host, port), ...].
 
     A fixed `port` applies only when nodes live on distinct hosts;
@@ -345,7 +761,7 @@ def start_per_node_http(host: str = "127.0.0.1", port: int = 0):
                     _HttpProxy, name=pname, lifetime="detached",
                     max_concurrency=16,
                     resources={f"node:{nid[:12]}": 0.001},
-                ).remote(host, port)
+                ).remote(host, port, max_inflight, legacy_threads)
             except Exception as create_exc:
                 # most likely a name collision (an RpcError, not a
                 # RayError): another driver is creating this proxy
@@ -393,9 +809,25 @@ def proxy_addresses():
 def shutdown_http():
     import ray_tpu
 
+    killed = []
     for node in ray_tpu.nodes():
+        pname = _proxy_name(node["node_id"])
         try:
-            proxy = ray_tpu.get_actor(_proxy_name(node["node_id"]))
+            proxy = ray_tpu.get_actor(pname)
             ray_tpu.kill(proxy)
+            killed.append(pname)
         except Exception:
             continue
+    # wait (bounded) for the names to deregister so an immediate
+    # restart — bench alternates data planes proxy-by-proxy — can't
+    # race a stale name into a dead-actor handle
+    deadline = time.monotonic() + 10
+    for pname in killed:
+        while time.monotonic() < deadline:
+            try:
+                ray_tpu.get_actor(pname)
+            except ValueError:
+                break  # name gone
+            except Exception:
+                break  # head unreachable: nothing more to wait on
+            time.sleep(0.05)

@@ -116,6 +116,84 @@ def test_get_handle_and_delete(cluster):
         serve.get_handle("tmp")
 
 
+# ------------------------------------------------- async-native data plane
+
+
+def test_get_async_and_await_ref(cluster):
+    """Awaitable object refs: ray_tpu.get_async / `await ref` /
+    ref.future() resolve on the calling event loop — errors and
+    timeouts surface exactly like the blocking get."""
+    import asyncio
+
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("x")
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+
+    async def drive():
+        vals = await ray_tpu.get_async([f.remote(i) for i in range(20)],
+                                       timeout=60)
+        assert vals == list(range(1, 21))
+        assert await f.remote(41) == 42
+        assert await f.remote(1).future() == 2
+        # plasma-stored values resolve through the same awaitable
+        big = b"x" * 200_000
+        assert await ray_tpu.get_async(ray_tpu.put(big), timeout=60) == big
+        with pytest.raises(ray_tpu.RayTaskError):
+            await ray_tpu.get_async(boom.remote(), timeout=60)
+        with pytest.raises(ray_tpu.GetTimeoutError):
+            await ray_tpu.get_async(slow.remote(), timeout=0.3)
+
+    asyncio.run(drive())
+
+
+def test_remote_async_and_stream_async(cluster):
+    """DeploymentHandle.remote_async/stream_async: same replica choice
+    and inflight accounting as the sync paths, awaitable end to end."""
+    import asyncio
+
+    @serve.deployment(name="async_dep")
+    class Dep:
+        def __call__(self, x):
+            return x * 2
+
+        def gen(self, n):
+            for i in range(int(n)):
+                yield i
+
+    handle = serve.run(Dep.bind())
+
+    async def drive():
+        refs = [await handle.remote_async(i) for i in range(8)]
+        assert await ray_tpu.get_async(refs, timeout=60) \
+            == [i * 2 for i in range(8)]
+        agen = await handle.stream_async(4, _method="gen")
+        out = []
+        async for ref in agen:
+            out.append(await ref)
+        assert out == [0, 1, 2, 3]
+
+    asyncio.run(drive())
+    # inflight accounting drains (remote_async charges are released by
+    # the shared waiter, streams by the consumer finally)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        with handle._lock:
+            if sum(handle._inflight.values()) == 0:
+                break
+        time.sleep(0.05)
+    with handle._lock:
+        assert sum(handle._inflight.values()) == 0, handle._inflight
+    serve.delete("async_dep")
+
+
 # -------------------------------------------- ingress / recovery / scaling
 
 

@@ -1,12 +1,241 @@
-"""Per-node Serve ingress test — in its own module because it stands up
-its own multi-node cluster and must not tear down test_serve.py's
-module-scoped runtime (reference: per-node proxy actors + long-poll
-route table)."""
+"""Serve HTTP ingress tests: the async event-loop data plane
+(pipelining, keep-alive-after-SSE, overload shedding, defensive
+parsing) plus the per-node proxy test — in its own module because the
+per-node test stands up its own multi-node cluster and must not tear
+down test_serve.py's module-scoped runtime (reference: per-node proxy
+actors + long-poll route table)."""
 
-import time  # noqa: F401 — kept for parity with test_serve helpers
+import json
+import socket
+import time
+
+import pytest
 
 import ray_tpu
 from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def proxy():
+    """Single-node cluster + default async proxy with a few fixture
+    deployments (echo, SSE generator, slow endpoint)."""
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+
+    @serve.deployment(name="pecho")
+    def pecho(x):
+        return {"v": x}
+
+    @serve.deployment(name="psse")
+    def psse(x):
+        for i in range(3):
+            yield f"tok{i}"
+
+    @serve.deployment(name="pslow", max_ongoing_requests=16)
+    def pslow(x):
+        import time as _t
+
+        _t.sleep(0.4)
+        return {"ok": 1}
+
+    serve.run(pecho.bind())
+    serve.run(psse.bind())
+    serve.run(pslow.bind())
+    host, port = serve.start_http()
+    try:
+        yield host, port
+    finally:
+        for fn in (serve.shutdown_http, serve.shutdown, ray_tpu.shutdown):
+            try:
+                fn()
+            except Exception:
+                pass
+
+
+def _read_response(f):
+    """Read one HTTP response off a socket file; returns
+    (status_line, headers, body) with chunked bodies de-framed."""
+    status = f.readline().decode("latin1")
+    headers = {}
+    while True:
+        line = f.readline()
+        if line in (b"\r\n", b"\n", b""):
+            break
+        k, _, v = line.decode("latin1").partition(":")
+        headers[k.strip().lower()] = v.strip()
+    body = b""
+    if headers.get("transfer-encoding") == "chunked":
+        while True:
+            size = int(f.readline().strip() or b"0", 16)
+            if size == 0:
+                f.readline()
+                break
+            body += f.read(size)
+            f.readline()
+    else:
+        clen = int(headers.get("content-length", 0) or 0)
+        if clen:
+            body = f.read(clen)
+    return status, headers, body
+
+
+def _connect(host, port):
+    s = socket.create_connection((host, port), timeout=30)
+    return s, s.makefile("rb")
+
+
+def test_pipelined_keepalive_requests(proxy):
+    """HTTP/1.1 pipelining: several requests written back-to-back on one
+    connection get their responses in request order, connection open
+    throughout."""
+    host, port = proxy
+    s, f = _connect(host, port)
+    try:
+        s.sendall(b"".join(
+            f"GET /pecho?x={i} HTTP/1.1\r\nHost: t\r\n\r\n".encode()
+            for i in range(3)))
+        for i in range(3):
+            status, headers, body = _read_response(f)
+            assert " 200 " in status, status
+            assert json.loads(body) == {"v": {"x": str(i)}}
+        # connection still usable after the pipelined burst
+        s.sendall(b"GET /pecho?x=9 HTTP/1.1\r\nHost: t\r\n\r\n")
+        status, _, body = _read_response(f)
+        assert json.loads(body) == {"v": {"x": "9"}}
+    finally:
+        s.close()
+
+
+def test_keepalive_after_sse(proxy):
+    """A chunked/SSE response leaves the connection alive (chunked
+    framing is self-terminating) — a follow-up request on the SAME
+    connection succeeds.  Also sends a traceparent header through the
+    async stream path (contextvar propagation must not break it)."""
+    host, port = proxy
+    s, f = _connect(host, port)
+    try:
+        s.sendall(b"GET /psse HTTP/1.1\r\nHost: t\r\n"
+                  b"Accept: text/event-stream\r\n"
+                  b"traceparent: 00-" + b"ab" * 16 + b"-" + b"cd" * 8 +
+                  b"-01\r\n\r\n")
+        status, headers, body = _read_response(f)
+        assert " 200 " in status, status
+        assert headers.get("transfer-encoding") == "chunked"
+        toks = [json.loads(l) for l in body.splitlines() if l.strip()]
+        assert toks == ["tok0", "tok1", "tok2"]
+        # the same connection serves a plain request afterwards
+        s.sendall(b"GET /pecho?x=after HTTP/1.1\r\nHost: t\r\n\r\n")
+        status, _, body = _read_response(f)
+        assert " 200 " in status and json.loads(body) == {"v": {"x": "after"}}
+    finally:
+        s.close()
+
+
+def test_http10_close_by_default(proxy):
+    """HTTP/1.0 semantics: close unless the client explicitly opts into
+    keep-alive."""
+    host, port = proxy
+    s, f = _connect(host, port)
+    try:
+        s.sendall(b"GET /pecho?x=1 HTTP/1.0\r\nHost: t\r\n\r\n")
+        status, headers, _ = _read_response(f)
+        assert " 200 " in status
+        assert headers.get("connection") == "close"
+        assert f.readline() == b""  # server closed the connection
+    finally:
+        s.close()
+    s, f = _connect(host, port)
+    try:
+        s.sendall(b"GET /pecho?x=1 HTTP/1.0\r\nHost: t\r\n"
+                  b"Connection: keep-alive\r\n\r\n")
+        status, headers, _ = _read_response(f)
+        assert headers.get("connection") == "keep-alive"
+        s.sendall(b"GET /pecho?x=2 HTTP/1.0\r\nHost: t\r\n\r\n")
+        status, _, body = _read_response(f)
+        assert json.loads(body) == {"v": {"x": "2"}}
+    finally:
+        s.close()
+
+
+def test_malformed_content_length_400(proxy):
+    """`Content-Length: abc` gets a defensive 400, not a torn-down
+    connection via the generic handler."""
+    host, port = proxy
+    s, f = _connect(host, port)
+    try:
+        s.sendall(b"POST /pecho HTTP/1.1\r\nHost: t\r\n"
+                  b"Content-Length: abc\r\n\r\n")
+        status, _, body = _read_response(f)
+        assert status.startswith("HTTP/1.1 400"), status
+        assert b"content-length" in body
+    finally:
+        s.close()
+
+
+def test_transfer_encoding_rejected(proxy):
+    """A chunked request body we don't de-frame would desync pipelined
+    request framing (smuggling vector) — refused with 501, connection
+    closed."""
+    host, port = proxy
+    s, f = _connect(host, port)
+    try:
+        s.sendall(b"POST /pecho HTTP/1.1\r\nHost: t\r\n"
+                  b"Transfer-Encoding: chunked\r\n\r\n"
+                  b"5\r\nhello\r\n0\r\n\r\n")
+        status, _, _ = _read_response(f)
+        assert status.startswith("HTTP/1.1 501"), status
+        assert f.readline() == b""  # framing untrusted: closed
+    finally:
+        s.close()
+
+
+def test_header_and_body_limits(proxy):
+    """Oversized headers shed with 431, oversized declared bodies with
+    413 — one misbehaving client cannot make the proxy buffer unbounded
+    memory."""
+    host, port = proxy
+    s, f = _connect(host, port)
+    try:
+        s.sendall(b"GET /pecho HTTP/1.1\r\nHost: t\r\n"
+                  b"X-Big: " + b"a" * 70_000 + b"\r\n\r\n")
+        status, _, _ = _read_response(f)
+        assert status.startswith("HTTP/1.1 431"), status
+    finally:
+        s.close()
+    s, f = _connect(host, port)
+    try:
+        s.sendall(b"POST /pecho HTTP/1.1\r\nHost: t\r\n"
+                  b"Content-Length: 999999999\r\n\r\n")
+        status, _, _ = _read_response(f)
+        assert status.startswith("HTTP/1.1 413"), status
+    finally:
+        s.close()
+
+
+def test_overload_shedding_503(proxy):
+    """Beyond the in-flight cap the proxy sheds with 503 instead of
+    queueing; capacity recovers once load drains."""
+    host, _ = proxy
+    serve.shutdown_http()
+    host, port = serve.start_http(max_inflight=2)
+    try:
+        conns = [_connect(host, port) for _ in range(6)]
+        for s, _ in conns:
+            s.sendall(b"GET /pslow HTTP/1.1\r\nHost: t\r\n\r\n")
+        statuses = []
+        for s, f in conns:
+            status, _, _ = _read_response(f)
+            statuses.append(status.split(" ", 2)[1])
+            s.close()
+        assert "200" in statuses, statuses
+        assert "503" in statuses, statuses
+        # after the burst drains, requests succeed again
+        s, f = _connect(host, port)
+        s.sendall(b"GET /pslow HTTP/1.1\r\nHost: t\r\n\r\n")
+        status, _, _ = _read_response(f)
+        assert " 200 " in status, status
+        s.close()
+    finally:
+        serve.shutdown_http()
 
 
 def test_per_node_proxies():
