@@ -380,6 +380,141 @@ def bench_put_gbps(ray_tpu, mb=100, iters=5):
     del refs
     return iters * mb / 1024 / dt
 
+def bench_xfer(pairs=2, mb=256):
+    """Bulk object-plane phase: two in-process node agents (plus a head)
+    on one event loop; a `mb`-MB object is pulled cross-agent via the
+    bulk transfer plane vs the legacy obj_chunk RPC path, alternating
+    rpc/bulk pairs and reporting BEST-OF per the slow-box protocol (the
+    ratio is the contract: bulk must be >= 3x the RPC baseline)."""
+    import asyncio
+
+    from ray_tpu._private.head import HeadService
+    from ray_tpu._private.node_agent import NodeAgent
+
+    size = mb * 1024 * 1024
+    session = os.path.join("/tmp", f"rt-xferbench-{os.getpid()}")
+    os.makedirs(session, exist_ok=True)
+    payload = os.urandom(size)
+    saved = os.environ.get("RT_OBJECT_TRANSFER_ENABLED")
+
+    async def run():
+        head = HeadService()
+        head_port = await head.start()
+        agents = []
+        for i in range(2):
+            ag = NodeAgent(("127.0.0.1", head_port), session, {"CPU": 1},
+                           arena_path=os.path.join(session, f"arena-{i}"),
+                           capacity=size + (64 << 20))
+            await ag.start()
+            agents.append(ag)
+        a, b = agents
+        rates = {"bulk": [], "rpc": []}
+        try:
+            for i in range(pairs):
+                for plane in ("rpc", "bulk"):
+                    os.environ["RT_OBJECT_TRANSFER_ENABLED"] = \
+                        "true" if plane == "bulk" else "false"
+                    oid = f"bench-{plane}-{i}"
+                    loc = a.store.create(oid, size)
+                    a.store.arena.view[
+                        loc["offset"]:loc["offset"] + size] = payload
+                    a.store.seal(oid)
+                    t0 = time.perf_counter()
+                    r = await asyncio.wait_for(
+                        b.rpc_ensure_local(oid, src=[a.host, a.port]),
+                        timeout=300)
+                    dt = time.perf_counter() - t0
+                    if not r.get("ok"):
+                        raise RuntimeError(f"{plane} pull failed: {r}")
+                    rates[plane].append(size / dt / 1e9)
+                    # the puller's unpin is a oneway still in flight:
+                    # wait it out so the freed arena space is reusable
+                    # by the next round's create
+                    for _ in range(200):
+                        e = a.store.objects.get(oid)
+                        if e is None or not e.pinned:
+                            break
+                        await asyncio.sleep(0.02)
+                    b.store.free([oid])
+                    a.store.free([oid])
+        finally:
+            for ag in agents:
+                await ag.stop()
+            await head.stop()
+        return rates
+
+    try:
+        rates = asyncio.run(run())
+    finally:
+        if saved is None:
+            os.environ.pop("RT_OBJECT_TRANSFER_ENABLED", None)
+        else:
+            os.environ["RT_OBJECT_TRANSFER_ENABLED"] = saved
+    bulk, rpc = max(rates["bulk"]), max(rates["rpc"])
+    return {
+        "xfer_gb_per_s": round(bulk, 3),
+        "xfer_rpc_baseline_gb_per_s": round(rpc, 3),
+        "xfer_vs_rpc": round(bulk / rpc, 2),
+    }
+
+def _locality_bench(n=10):
+    """Runs as a subprocess: 2-worker-node cluster, scatter `n` 2MB
+    objects across them, then unconstrained gather tasks — reports the
+    fraction routed to their argument's holder (and that held args were
+    never transferred)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2, resources={"s0": 1})
+    cluster.add_node(num_cpus=2, resources={"s1": 1})
+    ray_tpu.init(address=cluster.address)
+    try:
+        cluster.wait_for_nodes(3)
+        import numpy as np
+
+        @ray_tpu.remote
+        def produce():
+            import os as _os
+
+            return _os.environ["RT_NODE_ID"], np.ones(
+                300_000, dtype=np.float64)  # 2.4MB: plasma + directory
+
+        @ray_tpu.remote
+        def consume(pair):
+            import os as _os
+
+            holder, arr = pair
+            return _os.environ["RT_NODE_ID"] == holder and arr.sum() > 0
+
+        # scatter: pin producers alternately to the two worker nodes
+        refs = []
+        for i in range(n):
+            shard = f"s{i % 2}"
+            refs.append(produce.options(resources={shard: 0.01}).remote())
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=60)
+        # gather: unconstrained consumers — locality should route each
+        # to its argument's holder
+        hits = ray_tpu.get([consume.remote(r) for r in refs], timeout=60)
+        pct = 100.0 * sum(bool(h) for h in hits) / len(hits)
+        print("LOCJSON " + json.dumps({"locality_hit_pct": round(pct, 1)}))
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+def bench_locality_subprocess():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--locality-bench"],
+        capture_output=True, text=True, timeout=240, cwd=REPO)
+    for line in proc.stdout.splitlines():
+        if line.startswith("LOCJSON "):
+            return json.loads(line[len("LOCJSON "):])
+    raise RuntimeError(
+        f"locality bench rc={proc.returncode}: {proc.stderr[-400:]}")
+
 def _train_bench_loop(force_cpu=False):
     """Runs in a watchdogged subprocess; prints one JSON line."""
     import dataclasses
@@ -517,6 +652,12 @@ def main():
         except Exception as exc:  # noqa: BLE001
             errors["shutdown"] = f"{type(exc).__name__}: {exc}"[:300]
 
+    # post-shutdown phases: the object-plane pair runs its own
+    # in-process agents and the locality workload its own subprocess
+    # cluster — neither shares state with the main cluster above
+    phase("xfer", lambda: extras.update(bench_xfer()))
+    phase("locality", lambda: extras.update(bench_locality_subprocess()))
+
     # train runs AFTER shutdown so the chip is free for the subprocess
     _run_train_subprocess(extras, errors)
 
@@ -533,6 +674,9 @@ def main():
 if __name__ == "__main__":
     if "--train-bench" in sys.argv:
         _train_bench_loop(force_cpu="--cpu" in sys.argv)
+    elif "--locality-bench" in sys.argv:
+        sys.path.insert(0, REPO)
+        _locality_bench()
     elif "--client-bench" in sys.argv:
         sys.path.insert(0, REPO)
         i = sys.argv.index("--client-bench")

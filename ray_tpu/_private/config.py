@@ -41,6 +41,19 @@ _def("object_store_memory_bytes", 512 * 1024 * 1024)
 _def("object_store_fallback_directory", "/tmp/ray_tpu_spill")
 _def("object_spilling_threshold", 0.8)
 _def("object_transfer_chunk_bytes", 4 * 1024 * 1024)
+# --- bulk object-transfer plane (see _private/object_transfer.py) -----------
+_def("object_transfer_enabled", True)   # False: legacy obj_chunk RPC pulls
+_def("object_transfer_window", 8)       # in-flight chunk requests per stream
+# objects at/above this ride several parallel stripe streams
+_def("object_transfer_parallel_threshold", 64 * 1024 * 1024)
+_def("object_transfer_max_streams", 2)
+_def("object_transfer_sock_buf_bytes", 4 * 1024 * 1024)  # SO_SNDBUF/SO_RCVBUF
+# --- locality-aware scheduling ----------------------------------------------
+# minimum argument bytes a node must already hold before locality
+# overrides the hybrid policy; also the floor for the object directory
+# entries piggybacked on heartbeats (0 disables locality scheduling)
+_def("locality_min_bytes", 1024 * 1024)
+_def("object_directory_max_entries", 128)  # per-node heartbeat summary cap
 # --- control plane ----------------------------------------------------------
 _def("gcs_health_check_period_ms", 3_000)   # ref: ray_config_def.h:841-847
 _def("gcs_health_check_failure_threshold", 5)

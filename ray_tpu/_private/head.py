@@ -131,11 +131,12 @@ class _ActorEntry:
 class _NodeEntry:
     __slots__ = ("node_id", "host", "port", "arena_path", "resources",
                  "last_heartbeat", "client", "is_head_node",
-                 "pending_demands", "labels")
+                 "pending_demands", "labels", "xfer_port", "objects")
 
     def __init__(self, node_id: str, host: str, port: int, arena_path: str,
                  resources: NodeResources, is_head_node: bool,
-                 labels: Optional[Dict[str, str]] = None):
+                 labels: Optional[Dict[str, str]] = None,
+                 xfer_port: int = 0):
         self.node_id = node_id
         self.host = host
         self.port = port
@@ -150,6 +151,14 @@ class _NodeEntry:
         # static key/value labels for NodeLabelSchedulingStrategy
         # (reference: common.proto NodeLabels)
         self.labels: Dict[str, str] = labels or {}
+        # bulk object-transfer plane listener (object_transfer.py)
+        self.xfer_port = xfer_port
+        # object directory: large sealed objects on this node's store
+        # ({oid: size}, heartbeat snapshots) — the cluster-view copy lets
+        # spillback locality scoring see copies the submitter's hints
+        # don't know about, and feeds multi-source pull retry
+        # (reference: the GCS-backed ObjectDirectory)
+        self.objects: Dict[str, int] = {}
 
     def table_entry(self) -> Dict[str, Any]:
         return {
@@ -159,6 +168,7 @@ class _NodeEntry:
             "resources": self.resources.to_dict(),
             "is_head_node": self.is_head_node,
             "labels": self.labels,
+            "xfer_port": self.xfer_port,
         }
 
 
@@ -175,6 +185,11 @@ class HeadService(RpcHost):
         self._persist_task: Optional[asyncio.Task] = None
         self._node_conns: Dict[Any, str] = {}  # conn -> node_id
         self._cluster_version = 0  # bumped on membership change
+        # bumped whenever any node's object-directory snapshot changes;
+        # heartbeat replies omit the (potentially large) per-node
+        # `objects` maps for agents already at this version, so
+        # directory gossip costs O(nodes) only while objects churn
+        self._dir_version = 0
         self._shutdown = asyncio.Event()
         # general pub/sub: per-channel ring buffer + long-poll waiters
         # (reference: pubsub/publisher.h:307 — typed channels for node
@@ -301,7 +316,8 @@ class HeadService(RpcHost):
                 {"node_id": n.node_id, "host": n.host, "port": n.port,
                  "arena_path": n.arena_path, "is_head_node": n.is_head_node,
                  "total": n.resources.total.to_dict(),
-                 "available": n.resources.available.to_dict()}
+                 "available": n.resources.available.to_dict(),
+                 "xfer_port": n.xfer_port}
                 for n in self.nodes.values()],
         }
 
@@ -371,7 +387,7 @@ class HeadService(RpcHost):
             entry = _NodeEntry(
                 nd["node_id"], nd["host"], nd["port"], nd["arena_path"],
                 NodeResources(ResourceSet(nd["total"])),
-                nd["is_head_node"])
+                nd["is_head_node"], xfer_port=nd.get("xfer_port", 0))
             entry.resources.available = ResourceSet(nd["available"])
             self.nodes[entry.node_id] = entry
         self.restarted = True
@@ -385,10 +401,10 @@ class HeadService(RpcHost):
                                 arena_path: str, resources: Dict[str, float],
                                 is_head_node: bool = False,
                                 labels: Optional[Dict[str, str]] = None,
-                                _conn=None):
+                                xfer_port: int = 0, _conn=None):
         entry = _NodeEntry(node_id, host, port, arena_path,
                            NodeResources(ResourceSet(resources)), is_head_node,
-                           labels=labels or {})
+                           labels=labels or {}, xfer_port=xfer_port)
         self.nodes[node_id] = entry
         if _conn is not None:
             self._node_conns[_conn] = node_id
@@ -405,7 +421,8 @@ class HeadService(RpcHost):
             pg.opt_wait_used = False
         self._wake_pending_pgs()
         return {"ok": True, "cluster": self._cluster_view(),
-                "version": self._cluster_version}
+                "version": self._cluster_version,
+                "dir_version": self._dir_version}
 
     def _broadcast_cluster_view(self):
         """Membership changed: push the fresh view to every agent so
@@ -414,6 +431,7 @@ class HeadService(RpcHost):
         wedged agent can't stall the others."""
         view = self._cluster_view()
         version = self._cluster_version
+        dir_version = self._dir_version
         scalable = self._scalable_shapes()
 
         async def _push_one(conn):
@@ -421,6 +439,7 @@ class HeadService(RpcHost):
                 await asyncio.wait_for(
                     conn.push("cluster_update",
                               {"cluster": view, "version": version,
+                               "dir_version": dir_version,
                                "scalable": scalable}),
                     timeout=5.0)
             except Exception:
@@ -430,7 +449,9 @@ class HeadService(RpcHost):
             asyncio.ensure_future(_push_one(conn))
 
     async def rpc_heartbeat(self, node_id: str, available: Dict[str, float],
-                            pending: Optional[List[Dict[str, float]]] = None):
+                            pending: Optional[List[Dict[str, float]]] = None,
+                            objects: Optional[List[List[Any]]] = None,
+                            seen_dir_version: int = -1):
         entry = self.nodes.get(node_id)
         if entry is None:
             return {"unknown_node": True}
@@ -439,10 +460,34 @@ class HeadService(RpcHost):
         changed = fresh != entry.resources.available
         entry.resources.available = fresh
         entry.pending_demands = pending or []
+        if objects is not None:
+            # full snapshot each beat: removals need no tombstones
+            snap = {oid: size for oid, size in objects}
+            if snap != entry.objects:
+                entry.objects = snap
+                self._dir_version += 1
         if changed:
             self._wake_pending_pgs()
-        return {"cluster": self._cluster_view(), "version": self._cluster_version,
+        return {"cluster": self._cluster_view(
+                    include_objects=seen_dir_version != self._dir_version),
+                "version": self._cluster_version,
+                "dir_version": self._dir_version,
                 "scalable": self._scalable_shapes()}
+
+    async def rpc_object_locations(self, oids: List[str]):
+        """Directory lookup: which nodes' stores hold each oid (per the
+        latest heartbeat summaries).  Pullers use it to retry from an
+        alternate holder when their recorded source died mid-transfer
+        (reference: ObjectDirectory location subscriptions)."""
+        out: Dict[str, List[List[Any]]] = {}
+        for oid in oids:
+            holders = []
+            for n in self.nodes.values():
+                if oid in n.objects:
+                    holders.append([n.host, n.port])
+            if holders:
+                out[oid] = holders
+        return {"locations": out}
 
     async def rpc_node_table(self):
         return {nid: n.table_entry() for nid, n in self.nodes.items()}
@@ -501,12 +546,19 @@ class HeadService(RpcHost):
         await self._on_node_dead(node_id, "drained")
         return {"ok": True}
 
-    def _cluster_view(self) -> Dict[str, Any]:
-        return {
-            nid: {"addr": [n.host, n.port], "res": n.resources.to_dict(),
-                  "labels": n.labels}
-            for nid, n in self.nodes.items()
-        }
+    def _cluster_view(self, include_objects: bool = True) -> Dict[str, Any]:
+        """Per-node resources/labels, plus (when ``include_objects``)
+        the object-directory maps — omitted for heartbeat repliers
+        already at the current dir_version; agents then retain the
+        objects from their cached view."""
+        view: Dict[str, Any] = {}
+        for nid, n in self.nodes.items():
+            entry = {"addr": [n.host, n.port], "res": n.resources.to_dict(),
+                     "labels": n.labels, "xfer": n.xfer_port}
+            if include_objects:
+                entry["objects"] = n.objects
+            view[nid] = entry
+        return view
 
     def on_peer_disconnect(self, conn) -> None:
         node_id = self._node_conns.pop(conn, None)

@@ -253,6 +253,30 @@ def _merge_families(lines: List[str]) -> List[str]:
 
 default_registry = MetricsRegistry()
 
+_xfer_metrics: Optional[Tuple[Counter, Histogram]] = None
+
+
+def object_transfer_metrics() -> Tuple[Counter, Histogram]:
+    """Process-singleton bulk-transfer metrics, observed on the PULLING
+    node agent once per completed cross-node object transfer:
+    ``ray_tpu_object_transfer_bytes_total`` (labeled by plane=bulk|rpc
+    and direction=in) and ``ray_tpu_object_transfer_seconds`` (wall time
+    per transfer, same labels) — throughput is bytes_total/seconds_sum
+    per plane.  Lives here so the agent's registry exports them on the
+    standard per-node Prometheus endpoint."""
+    global _xfer_metrics
+    if _xfer_metrics is None:
+        _xfer_metrics = (
+            Counter("ray_tpu_object_transfer_bytes_total",
+                    "bytes moved between node object stores"),
+            Histogram("ray_tpu_object_transfer_seconds",
+                      "wall time of one cross-node object transfer",
+                      boundaries=[0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
+                                  0.25, 0.5, 1, 2.5, 5, 10, 30, 60]),
+        )
+    return _xfer_metrics
+
+
 _serve_request_latency: Optional[Histogram] = None
 
 

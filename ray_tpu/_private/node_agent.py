@@ -36,6 +36,9 @@ from ray_tpu._private.config import config
 from ray_tpu._private.errors import RuntimeEnvSetupError
 from ray_tpu._private.ids import NodeID, WorkerID
 from ray_tpu._private.object_store import StoreCore
+from ray_tpu._private.object_transfer import (ObjectTransferClient,
+                                              ObjectTransferServer,
+                                              TransferError, dest_view)
 from ray_tpu._private.resources import NodeResources, ResourceSet
 from ray_tpu._private.rpc import RpcClient, RpcHost, RpcServer
 from ray_tpu._private.scheduler import LocalScheduler, pick_node
@@ -129,11 +132,24 @@ class NodeAgent(RpcHost):
         self._bundles: Dict[str, LocalScheduler] = {}
         self.cluster_view: Dict[str, Any] = {}
         self._cluster_view_version = -1
+        # last object-directory version folded into cluster_view; sent
+        # with heartbeats so the head can omit unchanged `objects` maps
+        self._seen_dir_version = -1
         self._server: Optional[RpcServer] = None
         self.port = 0
         self.host = "127.0.0.1"
         self._head: Optional[RpcClient] = None
         self._peers: Dict[Tuple[str, int], RpcClient] = {}
+        # bulk object-transfer plane (object_transfer.py): own listener +
+        # pooled raw streams per peer; control RPC stays on self._peers
+        self._xfer = ObjectTransferServer(self.store)
+        self.xfer_port = 0
+        self._xfer_clients: Dict[Tuple[str, int], ObjectTransferClient] = {}
+        # observability for pulls (also surfaced via rpc_node_info)
+        self.xfer_stats: Dict[str, int] = {
+            "pulls": 0, "bulk_pulls": 0, "rpc_pulls": 0, "bytes_in": 0,
+            "prefetch_started": 0, "alt_source_retries": 0,
+            "bulk_fallbacks": 0}
         # worker pool
         self._workers: Dict[str, _Worker] = {}   # worker_id -> worker
         self._idle: List[_Worker] = []
@@ -173,14 +189,17 @@ class NodeAgent(RpcHost):
         self.host = host
         self._server = RpcServer(self, host, port)
         self.port = await self._server.start()
+        self.xfer_port = await self._xfer.start(host)
         self._head = RpcClient(self.head_addr[0], self.head_addr[1], label="head",
                                on_push=self._on_head_push)
         reply = await self._head.call(
             "register_node", node_id=self.node_id, host=self.host,
             port=self.port, arena_path=self.arena_path,
             resources=self.resources.total.to_dict(),
-            is_head_node=self.is_head_node, labels=self.labels)
-        self._apply_cluster_view(reply.get("cluster"), reply.get("version"))
+            is_head_node=self.is_head_node, labels=self.labels,
+            xfer_port=self.xfer_port)
+        self._apply_cluster_view(reply.get("cluster"), reply.get("version"),
+                                 dir_version=reply.get("dir_version"))
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
         if config.memory_monitor_refresh_ms > 0:
@@ -260,6 +279,11 @@ class NodeAgent(RpcHost):
             await self._head.close()
         for c in self._peers.values():
             await c.close()
+        self._peers.clear()
+        for xc in self._xfer_clients.values():
+            xc.close()
+        self._xfer_clients.clear()
+        await self._xfer.stop()
         if getattr(self, "_metrics_server", None) is not None:
             self._metrics_server.close()
         if self._server:
@@ -270,7 +294,8 @@ class NodeAgent(RpcHost):
     async def wait_for_shutdown(self):
         await self._shutdown.wait()
 
-    def _apply_cluster_view(self, view, version, scalable=None) -> None:
+    def _apply_cluster_view(self, view, version, scalable=None,
+                            dir_version=None) -> None:
         """Last-write-wins would let an older RPC-reply snapshot clobber a
         fresher pushed view; only apply monotonically newer versions."""
         if scalable is not None:
@@ -280,14 +305,23 @@ class NodeAgent(RpcHost):
         if version is None:
             version = self._cluster_view_version  # legacy: accept equal
         if version >= self._cluster_view_version:
+            for nid, entry in view.items():
+                if "objects" not in entry:
+                    # directory unchanged since our seen version: the
+                    # head omitted it — retain the cached maps
+                    entry["objects"] = (self.cluster_view.get(nid) or
+                                        {}).get("objects") or {}
             self.cluster_view = view
             self._cluster_view_version = version
+            if dir_version is not None:
+                self._seen_dir_version = dir_version
 
     def _on_head_push(self, method: str, payload):
         if method == "cluster_update":
             self._apply_cluster_view(payload.get("cluster"),
                                      payload.get("version"),
-                                     payload.get("scalable"))
+                                     payload.get("scalable"),
+                                     payload.get("dir_version"))
 
     def _pending_for_heartbeat(self) -> List[Dict[str, float]]:
         """Queued lease demands plus parked infeasible-but-scalable
@@ -305,7 +339,11 @@ class NodeAgent(RpcHost):
                 reply = await self._head.call(
                     "heartbeat", node_id=self.node_id,
                     available=self.resources.available.to_dict(),
-                    pending=self._pending_for_heartbeat())
+                    pending=self._pending_for_heartbeat(),
+                    objects=self.store.object_summary(
+                        int(config.locality_min_bytes),
+                        int(config.object_directory_max_entries)),
+                    seen_dir_version=self._seen_dir_version)
                 if reply.get("unknown_node"):
                     # the head restarted without our entry (or reaped us
                     # during its downtime): re-register under the SAME
@@ -317,10 +355,12 @@ class NodeAgent(RpcHost):
                         host=self.host, port=self.port,
                         arena_path=self.arena_path,
                         resources=self.resources.total.to_dict(),
-                        is_head_node=self.is_head_node, labels=self.labels)
+                        is_head_node=self.is_head_node, labels=self.labels,
+                        xfer_port=self.xfer_port)
                 self._apply_cluster_view(reply.get("cluster"),
                                          reply.get("version"),
-                                         reply.get("scalable"))
+                                         reply.get("scalable"),
+                                         reply.get("dir_version"))
             except Exception:
                 pass  # head unreachable (possibly restarting) — keep trying
             try:
@@ -339,7 +379,18 @@ class NodeAgent(RpcHost):
 
     async def rpc_store_seal(self, oid: str):
         self.store.seal(oid)
+        entry = self.store.objects.get(oid)
+        if entry is not None and self._directory_worthy(entry.size):
+            # a directory-worthy object appeared: refresh the head's
+            # object directory now, not a full heartbeat period later —
+            # locality scheduling and multi-source retry see it in ~ms
+            self._hb_wake.set()
         return {"ok": True}
+
+    @staticmethod
+    def _directory_worthy(size: int) -> bool:
+        min_bytes = int(config.locality_min_bytes)
+        return min_bytes > 0 and size >= min_bytes
 
     async def rpc_store_abort(self, oid: str):
         self.store.abort(oid)
@@ -384,7 +435,11 @@ class NodeAgent(RpcHost):
     async def rpc_store_usage(self):
         return self.store.usage()
 
-    # ---- object transfer (pull-based, chunked) -----------------------------
+    # ---- object transfer (pull-based) --------------------------------------
+    # Control (size lookup, pin/unpin) rides the msgpack RPC connection;
+    # bytes ride the bulk plane (object_transfer.py) — a dedicated raw
+    # stream pool on its own listener — with the chunked obj_chunk RPC
+    # kept as the compat/fallback path (and the bench baseline).
 
     async def rpc_obj_info(self, oid: str, pin_for: str = ""):
         """Peer asks for size before pulling; pins so chunks stay valid."""
@@ -392,72 +447,119 @@ class NodeAgent(RpcHost):
         loc = locs[0]
         if loc is None or loc.get("deleted"):
             return {"found": False}
-        return {"found": True, "size": loc["size"]}
+        return {"found": True, "size": loc["size"],
+                "xfer_port": self.xfer_port}
 
     async def rpc_obj_chunk(self, oid: str, offset: int, length: int):
-        entry = self.store.objects.get(oid)
-        if entry is None or not entry.sealed:
+        # memoryview reply: msgpack serializes buffer-protocol objects
+        # directly, so the chunk is copied once into the reply frame
+        # instead of bytes()-copied first; disk-fallback objects come
+        # from the transfer server's mmap cache (held across the pull,
+        # not reopened per chunk)
+        view = self._xfer.object_view(oid, offset, length)
+        if view is None:
             return {"found": False}
-        if entry.location == "shm":
-            data = bytes(self.store.arena.view[
-                entry.offset + offset: entry.offset + offset + length])
-        else:
-            with open(entry.path, "rb") as f:
-                f.seek(offset)
-                data = f.read(length)
-        return {"found": True, "data": data}
+        return {"found": True, "data": view}
 
     async def rpc_obj_unpin(self, oid: str, pin_for: str = ""):
         self.store.release(oid, pin_for or "xfer")
+        self._xfer.release(oid)  # drop mappings held across the pull
         return {"ok": True}
 
     async def rpc_ensure_local(self, oid: str, src: Optional[List] = None):
         """Pull oid into the local store from the node at `src` (host,port).
 
         Concurrent pulls of the same oid are deduplicated
-        (reference: pull_manager.h).
+        (reference: pull_manager.h).  A pull whose source fails mid-way
+        re-resolves holders from the head's object directory and retries
+        once from an alternate before erroring.
         """
         if self.store.contains(oid):
             return {"ok": True, "local": True}
         if not src or (src[0] == self.host and src[1] == self.port):
-            return {"ok": False, "error": "object not local and no source"}
-        fut = self._pulls.get(oid)
-        if fut is None:
-            fut = asyncio.ensure_future(self._pull(oid, (src[0], src[1])))
-            self._pulls[oid] = fut
-            fut.add_done_callback(lambda _: self._pulls.pop(oid, None))
+            # no usable source given: the head's directory may know one
+            alts = await self._alt_sources(oid)
+            if not alts:
+                return {"ok": False, "error": "object not local and no source"}
+            src = alts[0]
         try:
-            await asyncio.shield(fut)
+            await self._ensure_pull(oid, (src[0], src[1]))
             return {"ok": True}
         except Exception as e:
             return {"ok": False, "error": str(e)}
 
+    def _ensure_pull(self, oid: str, src: Tuple[str, int]):
+        """The deduplicated pull future for oid (shared by ensure_local
+        and prefetch-on-lease); shielded so one cancelled waiter cannot
+        kill the transfer for the others."""
+        fut = self._pulls.get(oid)
+        if fut is None:
+            fut = asyncio.ensure_future(self._pull_with_retry(oid, src))
+            self._pulls[oid] = fut
+            fut.add_done_callback(lambda _: self._pulls.pop(oid, None))
+        return asyncio.shield(fut)
+
+    async def _pull_with_retry(self, oid: str, src: Tuple[str, int]):
+        try:
+            return await self._pull(oid, src)
+        except Exception:
+            # the source may have died mid-pull: ask the head who else
+            # holds a copy and retry once from an alternate
+            alts = await self._alt_sources(oid, exclude={tuple(src)})
+            if not alts:
+                raise
+            self.xfer_stats["alt_source_retries"] += 1
+            return await self._pull(oid, alts[0])
+
+    async def _alt_sources(self, oid: str,
+                           exclude=frozenset()) -> List[Tuple[str, int]]:
+        if self._head is None:
+            return []
+        try:
+            r = await self._head.call("object_locations", oids=[oid])
+        except Exception:
+            return []
+        out = []
+        for host, port in r.get("locations", {}).get(oid, []):
+            addr = (host, port)
+            if addr not in exclude and addr != (self.host, self.port):
+                out.append(addr)
+        return out
+
     async def _pull(self, oid: str, src: Tuple[str, int]):
+        from ray_tpu._private.metrics import object_transfer_metrics
+
         peer = self._peer(src)
         pin_id = f"xfer:{self.node_id[:12]}"
         info = await peer.call("obj_info", oid=oid, pin_for=pin_id)
         if not info.get("found"):
             raise KeyError(f"object {oid} not found at {src}")
         size = info["size"]
+        xfer_port = info.get("xfer_port", 0)
+        use_bulk = bool(xfer_port) and bool(config.object_transfer_enabled)
+        t0 = time.monotonic()
         try:
             loc = self.store.create(oid, size, primary=False)
             try:
-                chunk = config.object_transfer_chunk_bytes
-                pos = 0
-                while pos < size:
-                    n = min(chunk, size - pos)
-                    r = await peer.call("obj_chunk", oid=oid, offset=pos, length=n)
-                    if not r.get("found"):
-                        raise KeyError(f"object {oid} vanished at {src} mid-pull")
-                    data = r["data"]
-                    if loc["location"] == "shm":
-                        self.store.arena.view[
-                            loc["offset"] + pos: loc["offset"] + pos + len(data)] = data
-                    else:
-                        with open(loc["path"], "r+b") as f:
-                            f.seek(pos)
-                            f.write(data)
-                    pos += len(data)
+                if use_bulk:
+                    try:
+                        client = self._xfer_client((src[0], xfer_port))
+                        view, mapped = dest_view(self.store, loc)
+                        try:
+                            await client.fetch_into(oid, view)
+                        finally:
+                            if mapped is not None:
+                                mapped.close()
+                    except (TransferError, OSError):
+                        # transfer listener unreachable (filtered port,
+                        # dead thread) while the control RPC to this
+                        # peer demonstrably works — the chunk path must
+                        # still serve the bytes (refetch is idempotent)
+                        use_bulk = False
+                        self.xfer_stats["bulk_fallbacks"] += 1
+                        await self._pull_chunks_rpc(peer, oid, size, loc)
+                else:
+                    await self._pull_chunks_rpc(peer, oid, size, loc)
                 self.store.seal(oid)
             except BaseException:
                 self.store.abort(oid)
@@ -467,14 +569,114 @@ class NodeAgent(RpcHost):
                 await peer.oneway("obj_unpin", oid=oid, pin_for=pin_id)
             except Exception:
                 pass
+        plane = "bulk" if use_bulk else "rpc"
+        bytes_total, seconds = object_transfer_metrics()
+        bytes_total.inc(size, tags={"plane": plane, "direction": "in"})
+        seconds.observe(time.monotonic() - t0,
+                        tags={"plane": plane, "direction": "in"})
+        self.xfer_stats["pulls"] += 1
+        self.xfer_stats[f"{plane}_pulls"] += 1
+        self.xfer_stats["bytes_in"] += size
+        if self._directory_worthy(size):
+            self._hb_wake.set()  # new holder: refresh the directory fast
+
+    async def _pull_chunks_rpc(self, peer: RpcClient, oid: str, size: int,
+                               loc: Dict[str, Any]):
+        """Legacy stop-and-wait chunk pull over the control RPC (used
+        against agents without a transfer plane, and as the bench
+        baseline for the bulk plane)."""
+        chunk = config.object_transfer_chunk_bytes
+        pos = 0
+        while pos < size:
+            n = min(chunk, size - pos)
+            r = await peer.call("obj_chunk", oid=oid, offset=pos, length=n)
+            if not r.get("found"):
+                raise KeyError(f"object {oid} vanished mid-pull")
+            data = r["data"]
+            if loc["location"] == "shm":
+                self.store.arena.view[
+                    loc["offset"] + pos: loc["offset"] + pos + len(data)] = data
+            else:
+                with open(loc["path"], "r+b") as f:
+                    f.seek(pos)
+                    f.write(data)
+            pos += len(data)
 
     def _peer(self, addr: Tuple[str, int]) -> RpcClient:
         addr = (addr[0], addr[1])
         client = self._peers.get(addr)
         if client is None or client.dead:
+            if client is not None:
+                # close the replaced dead client: dropping it on the
+                # floor leaks its fd and read task until process exit
+                asyncio.ensure_future(client.close())
             client = RpcClient(addr[0], addr[1], label=f"peer-{addr[1]}")
             self._peers[addr] = client
         return client
+
+    def _xfer_client(self, addr: Tuple[str, int]) -> ObjectTransferClient:
+        addr = (addr[0], addr[1])
+        client = self._xfer_clients.get(addr)
+        if client is None or client.closed:
+            client = ObjectTransferClient(addr[0], addr[1])
+            self._xfer_clients[addr] = client
+        return client
+
+    # ---- locality + prefetch -----------------------------------------------
+
+    def _arg_bytes_by_node(self, ts: TaskSpec) -> Dict[str, float]:
+        """Argument bytes already resident per node, from the spec's
+        owner-stamped hints plus the head-gossiped object directory in
+        the cluster view (which also sees secondary copies made by
+        earlier prefetches) plus our own store."""
+        out: Dict[str, float] = {}
+        addr_to_node = {tuple(v["addr"]): nid
+                        for nid, v in self.cluster_view.items()}
+        addr_to_node[(self.host, self.port)] = self.node_id
+        for arg in ts.args:
+            oid = arg.object_id
+            if oid is None or not arg.size:
+                continue
+            holders = set()
+            if arg.loc:
+                nid = addr_to_node.get(tuple(arg.loc))
+                if nid is not None:
+                    holders.add(nid)
+            for nid, v in self.cluster_view.items():
+                if oid in (v.get("objects") or {}):
+                    holders.add(nid)
+            if self.store.contains(oid):
+                holders.add(self.node_id)
+            for nid in holders:
+                out[nid] = out.get(nid, 0.0) + arg.size
+        return out
+
+    def _prefetch_args(self, ts: TaskSpec) -> None:
+        """The lease will be serviced here: start pulling hinted remote
+        args NOW, in one gather deduped against in-flight pulls, so the
+        transfer overlaps queue wait and worker startup instead of
+        serializing in front of execution (reference: the raylet's pull
+        manager fetching task dependencies while the lease queues)."""
+        pulls: Dict[str, Tuple[str, int]] = {}
+        for arg in ts.args:
+            oid = arg.object_id
+            if oid is None or not arg.loc or oid in pulls:
+                continue
+            src = (arg.loc[0], arg.loc[1])
+            if src == (self.host, self.port) or self.store.contains(oid) \
+                    or oid in self._pulls:
+                continue
+            pulls[oid] = src
+        if not pulls:
+            return
+        self.xfer_stats["prefetch_started"] += len(pulls)
+
+        async def _gather():
+            await asyncio.gather(
+                *[self._ensure_pull(oid, src) for oid, src in pulls.items()],
+                return_exceptions=True)  # the worker's get() retries/errors
+
+        asyncio.ensure_future(_gather())
 
     # ---- worker pool -------------------------------------------------------
 
@@ -767,7 +969,9 @@ class NodeAgent(RpcHost):
                 spread_threshold=config.scheduler_spread_threshold,
                 top_k_fraction=config.scheduler_top_k_fraction,
                 top_k_absolute=config.scheduler_top_k_absolute,
-                strategy=ts.scheduling_strategy, labels_by_node=labels)
+                strategy=ts.scheduling_strategy, labels_by_node=labels,
+                arg_bytes_by_node=self._arg_bytes_by_node(ts),
+                locality_min_bytes=int(config.locality_min_bytes))
             if target is None:
                 # hard affinity/label constraints name specific nodes;
                 # autoscaled capacity can never satisfy them, so they
@@ -794,6 +998,9 @@ class NodeAgent(RpcHost):
         if not self.resources.is_feasible(demand):
             return {"error": "infeasible",
                     "error_str": f"node cannot satisfy {demand.to_dict()}"}
+        # the task will run here (or queue here): overlap its argument
+        # transfers with the queue wait / worker startup
+        self._prefetch_args(ts)
         return await self._acquire_and_grant(self.local, demand, "", ts, _conn,
                                              req_id)
 
@@ -812,6 +1019,7 @@ class NodeAgent(RpcHost):
             return {"error": "infeasible",
                     "error_str": f"demand {demand.to_dict()} exceeds bundle "
                                  f"{key} capacity"}
+        self._prefetch_args(ts)
         return await self._acquire_and_grant(sched, demand, key, ts, conn,
                                              req_id)
 
@@ -1199,6 +1407,8 @@ class NodeAgent(RpcHost):
             "num_idle": len(self._idle),
             "num_leases": len(self._leases),
             "store": self.store.usage(),
+            "xfer_port": self.xfer_port,
+            "xfer_stats": dict(self.xfer_stats),
         }
 
     async def rpc_ping(self):

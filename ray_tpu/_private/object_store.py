@@ -342,6 +342,23 @@ class StoreCore:
             "num_evicted": self.num_evicted,
         }
 
+    def object_summary(self, min_bytes: int, limit: int) -> List[List[Any]]:
+        """[oid, size] pairs for sealed objects at/above min_bytes —
+        piggybacked on heartbeats to feed the head's object directory
+        (locality-aware spillback + multi-source pull retry).  Largest
+        first, so the cap drops the entries that matter least.
+        min_bytes <= 0 means locality is disabled: report nothing
+        rather than every tiny object."""
+        if min_bytes <= 0:
+            return []
+        out = [[oid, e.size] for oid, e in self.objects.items()
+               if e.sealed and e.size >= min_bytes
+               and oid not in self._deleted]
+        if len(out) > limit:
+            out.sort(key=lambda p: -p[1])
+            del out[limit:]
+        return out
+
     def list_objects(self, limit: int = 1000) -> List[Dict[str, Any]]:
         """Object summaries for the state API (reference:
         GetObjectsInfo in node_manager.proto:405)."""

@@ -93,9 +93,22 @@ def pick_node(
     rng: Optional[random.Random] = None,
     strategy: Optional[Dict[str, object]] = None,
     labels_by_node: Optional[Dict[str, Dict[str, str]]] = None,
+    arg_bytes_by_node: Optional[Dict[str, float]] = None,
+    locality_min_bytes: int = 0,
 ) -> Optional[str]:
     """Hybrid policy: choose the node to send a lease request to.
 
+    0. Locality: with ``arg_bytes_by_node`` (argument bytes already
+       resident per node, from the submission's WireArg hints plus the
+       head's object directory) a feasible node holding at least
+       ``locality_min_bytes`` wins, skipping the transfer entirely —
+       the holder with the most bytes that can fit the demand now,
+       else the best busy-but-feasible holder (the lease queues there;
+       queued demand triggers the warm-lease reclaim push).  Reference:
+       locality_aware_lease_policy.cc — "the best node is the one with
+       the most object bytes local".  Explicit strategy overrides
+       disable this; infeasible holders fall through to the hybrid
+       default below.
     1. Local node if it has the resources available and is under the
        spread threshold.
     2. Otherwise a random pick among the top-k least-utilized nodes with
@@ -148,6 +161,26 @@ def pick_node(
             feasible.sort(key=lambda nid: cluster[nid].utilization())
             return feasible[0]
         return None
+    if not stype and arg_bytes_by_node and locality_min_bytes > 0:
+        # most-bytes-first; ties broken toward the colder node, then a
+        # stable id order so repeated submissions don't flap
+        holders = sorted(
+            ((b, nid) for nid, b in arg_bytes_by_node.items()
+             if b >= locality_min_bytes and nid in cluster
+             and cluster[nid].is_feasible(demand)),
+            key=lambda kv: (-kv[0], cluster[kv[1]].utilization(), kv[1]))
+        for _b, nid in holders:
+            if cluster[nid].can_fit(demand):
+                return nid
+        if holders:
+            # no holder has free capacity RIGHT NOW, but skipping the
+            # transfer usually beats a short queue wait: send the lease
+            # to the best holder anyway — queued demand there triggers
+            # the warm-lease reclaim push, and the holder's own
+            # pick_node pass can still spill the request back if it is
+            # genuinely saturated (reference: locality_aware lease
+            # policy + retry_at_raylet spillback)
+            return holders[0][1]
     local = cluster.get(local_node_id)
     if (local is not None and local.can_fit(demand)
             and local.utilization() < spread_threshold):

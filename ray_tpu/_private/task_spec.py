@@ -30,6 +30,13 @@ class WireArg:
     object_id: Optional[str] = None  # hex
     owner_addr: Optional[Tuple[str, int]] = None  # (host, port) of owner's RPC
     kw: Optional[str] = None  # keyword name; None for positional
+    # locality hints, stamped from the owner's reference table at submit
+    # time (reference: lease_policy.cc best-effort locality data): the
+    # node-agent addr holding the primary plasma copy, and its size —
+    # pick_node scores feasible nodes by argument bytes already local,
+    # and the granting agent prefetches hinted args on lease grant
+    size: int = 0
+    loc: Optional[Tuple[str, int]] = None  # (host, port) of a holder agent
 
     def to_wire(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {}
@@ -41,6 +48,10 @@ class WireArg:
             d["oid"] = self.object_id
             if self.owner_addr:
                 d["owner"] = list(self.owner_addr)
+            if self.size:
+                d["sz"] = self.size
+            if self.loc:
+                d["loc"] = list(self.loc)
         if self.kw:
             d["kw"] = self.kw
         return d
@@ -48,11 +59,14 @@ class WireArg:
     @classmethod
     def from_wire(cls, d: Dict[str, Any]) -> "WireArg":
         owner = d.get("owner")
+        loc = d.get("loc")
         return cls(
             value=d.get("v"),
             object_id=d.get("oid"),
             owner_addr=tuple(owner) if owner else None,
             kw=d.get("kw"),
+            size=d.get("sz", 0),
+            loc=tuple(loc) if loc else None,
         )
 
 

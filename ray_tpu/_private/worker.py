@@ -67,6 +67,8 @@ MODE_WORKER = "worker"
 
 _TASK_PUSH_TIMEOUT = 7 * 86400.0  # tasks may legitimately run for days
 _WARM_LEASE_TTL_S = 0.2  # idle leases stay pooled this long before return
+_LOCALITY_DEFER_S = 1.0  # max time the pump holds a task back waiting
+# for a lease on the node that already holds its argument bytes
 _PIPELINE_DEPTH_MAX = 24  # cap on tasks in flight per leased worker
 _PIPELINE_BUDGET_S = 0.024  # per-lease pipeline covers this much work:
 # depth = budget / measured per-task EXECUTION time, so sub-ms tasks
@@ -116,7 +118,7 @@ _exec_ctx = contextvars.ContextVar("rt_exec_shadow", default=None)
 
 class _TaskState:
     __slots__ = ("spec", "contained_refs", "retries_left", "sched_key",
-                 "return_oids", "deps_ready", "cancelled")
+                 "return_oids", "deps_ready", "cancelled", "defer_deadline")
 
     def __init__(self, spec: TaskSpec, contained_refs: List[ObjectRef]):
         self.spec = spec
@@ -125,6 +127,10 @@ class _TaskState:
         self.sched_key = spec.scheduling_class()
         self.deps_ready = True
         self.cancelled = False  # ray_tpu.cancel hit it mid-resolution
+        # locality dispatch: how long the pump may hold this task back
+        # waiting for a lease on its argument-holding node (0 = not yet
+        # deferred; set on first deferral, cleared never — bounded wait)
+        self.defer_deadline = 0.0
         self.return_oids = [
             ObjectID.from_index(TaskID.from_hex(spec.task_id), i + 1).hex()
             for i in range(spec.num_returns)
@@ -258,13 +264,18 @@ class _ServiceStats:
 
 class _SchedState:
     __slots__ = ("key", "pending", "leases", "inflight_requests", "stats",
-                 "request_agents", "req_counter", "pump_queued")
+                 "request_agents", "req_counter", "pump_queued",
+                 "defer_timer", "req_rr")
 
     def __init__(self, key: tuple = ()):
         self.key = key
         self.pending: deque = deque()
         self.leases: List[_Lease] = []
         self.inflight_requests = 0
+        # True while a deferred-locality re-pump timer is scheduled
+        self.defer_timer = False
+        # rotates which pending task's spec rides the next lease request
+        self.req_rr = 0
         # windowed execution-time stats driving the pipeline depth curve
         self.stats = _ServiceStats()
         # outstanding lease requests: req_id -> agent addr currently asked.
@@ -335,6 +346,11 @@ class CoreWorker(RpcHost):
         self.functions = FunctionManager(self.head)
         self.job_runtime_env: Dict[str, Any] = {}  # init(runtime_env=...)
         self._locations: Dict[str, Tuple[str, int]] = {}  # owned oid -> node
+        # owned oid -> plasma size: with _locations this is the owner's
+        # reference table half of locality scheduling — submissions stamp
+        # (loc, size) hints onto WireArgs so pick_node can score nodes by
+        # argument bytes already local and agents can prefetch
+        self._obj_sizes: Dict[str, int] = {}
         self._containers: Dict[str, List[ObjectRef]] = {}  # outer -> inner pins
         # lineage reconstruction (reference: object_recovery_manager.cc +
         # task_manager.h resubmit): while a plasma-stored return of an owned
@@ -629,6 +645,8 @@ class CoreWorker(RpcHost):
         elif "stored" in item:
             node = tuple(item["stored"]["node"])
             self._locations[oid] = node
+            if item["stored"].get("size"):
+                self._obj_sizes[oid] = item["stored"]["size"]
             self.memory.set_in_plasma(oid, node)
         else:
             return  # malformed item
@@ -775,6 +793,7 @@ class CoreWorker(RpcHost):
         self._drop_lineage(oid)
         self.memory.evict(oid)
         self._containers.pop(oid, None)  # releases nested pins via GC
+        self._obj_sizes.pop(oid, None)
         loc = self._locations.pop(oid, None)
         if loc is not None:
             self._spawn(self._send_free(loc, oid))
@@ -910,6 +929,7 @@ class CoreWorker(RpcHost):
         else:
             self.plasma.put_serialized(oid, frames, size, primary=True)
             self._locations[oid] = self.agent_addr
+            self._obj_sizes[oid] = size
             node_addr = self.agent_addr
         if ctx.refs:
             # the stored value embeds refs: pin them for the outer's lifetime
@@ -1350,7 +1370,8 @@ class CoreWorker(RpcHost):
             if isinstance(a, ObjectRef):
                 contained.append(a)
                 wire.append(WireArg(object_id=a.oid,
-                                    owner_addr=a.owner_addr or self.address, kw=kw))
+                                    owner_addr=a.owner_addr or self.address,
+                                    kw=kw, **self._arg_hints(a)))
                 continue
             with SerializationContext() as ctx:
                 blob = serialization.serialize_to_bytes(a)
@@ -1359,10 +1380,22 @@ class CoreWorker(RpcHost):
                 # big literal arg: put once, pass by ref
                 ref = self.put(a)
                 contained.append(ref)
-                wire.append(WireArg(object_id=ref.oid, owner_addr=self.address, kw=kw))
+                wire.append(WireArg(object_id=ref.oid, owner_addr=self.address,
+                                    kw=kw, **self._arg_hints(ref)))
             else:
                 wire.append(WireArg(value=blob, kw=kw))
         return wire, contained
+
+    def _arg_hints(self, ref: ObjectRef) -> Dict[str, Any]:
+        """Locality hints for a ref argument: (holder node addr, size)
+        from the owner's reference table, falling back to the ref's own
+        recorded plasma location for borrowed refs.  pick_node scores
+        nodes by these bytes; the granting agent prefetches them."""
+        loc = self._locations.get(ref.oid) \
+            or (tuple(ref.node_addr) if ref.node_addr else None)
+        if loc is None:
+            return {}
+        return {"loc": loc, "size": self._obj_sizes.get(ref.oid, 0)}
 
     def submit_task(self, function_id: str, args: tuple, kwargs: dict,
                     num_returns: int = 1, resources: Optional[Dict[str, float]] = None,
@@ -1479,6 +1512,15 @@ class CoreWorker(RpcHost):
             else:
                 arg.value = serialization.serialize_to_bytes(e.value)
                 arg.object_id = None
+        for arg in task.spec.args:
+            # refs that were still pending when _serialize_args stamped
+            # hints have resolved locations now: fill them in so the
+            # lease request can score locality / prefetch
+            if arg.object_id is not None and arg.loc is None:
+                loc = self._locations.get(arg.object_id)
+                if loc is not None:
+                    arg.loc = loc
+                    arg.size = self._obj_sizes.get(arg.object_id, 0)
         return True
 
     # ---------------------------------------------------------- cancellation
@@ -1650,6 +1692,28 @@ class CoreWorker(RpcHost):
         lease.dead = True
         await self._notify_drop(lease, kill)
 
+    @staticmethod
+    def _locality_pref_addr(spec: TaskSpec) -> Optional[Tuple[str, int]]:
+        """Agent addr holding this task's biggest hinted argument (past
+        the locality threshold), or None.  The pump prefers a lease on
+        that node so class-sharing pipelines don't undo the cluster
+        policy's locality routing."""
+        totals: Dict[Tuple[str, int], int] = {}
+        for a in spec.args:
+            if a.object_id is not None and a.loc and a.size:
+                key = (a.loc[0], a.loc[1])
+                totals[key] = totals.get(key, 0) + a.size
+        if not totals:
+            return None  # common case: config never consulted
+        # sum per node, mirroring pick_node's arg_bytes_by_node scoring
+        # (a node holding two medium args beats one holding a single
+        # larger arg); stable tie-break on the addr
+        best, best_size = max(totals.items(), key=lambda kv: (kv[1], kv[0]))
+        min_bytes = int(config.locality_min_bytes)
+        if min_bytes <= 0 or best_size < min_bytes:
+            return None
+        return best
+
     def _pump(self, state: _SchedState):
         # hand pending tasks to leases, shallowest pipeline first, at the
         # depth the service-time curve allows; adopt warm-pool leases
@@ -1661,9 +1725,11 @@ class CoreWorker(RpcHost):
         # ride ONE push_tasks frame instead of N push RPCs (reference:
         # direct task submission batches over the lease connection)
         batches: Dict[int, Tuple[_Lease, List[_TaskState]]] = {}
+        deferred: List[_TaskState] = []
+        now = time.monotonic()
         while state.pending:
-            lease = min(live, key=lambda l: len(l.inflight)) if live else None
-            if lease is None or len(lease.inflight) >= depth:
+            candidates = [l for l in live if len(l.inflight) < depth]
+            if not candidates:
                 adopted = (self._adopt_warm_lease(state)
                            if len(state.leases) < _MAX_LEASES_PER_CLASS
                            else None)
@@ -1672,8 +1738,54 @@ class CoreWorker(RpcHost):
                 live.append(adopted)
                 continue
             task = state.pending.popleft()
+            # a lease on the node already holding the task's argument
+            # bytes beats the shallowest pipeline: the task skips the
+            # transfer entirely (cluster-level locality routing decided
+            # node choice; this is its per-task dispatch counterpart)
+            lease = None
+            pref = self._locality_pref_addr(task.spec)
+            if pref is not None:
+                for cand in candidates:
+                    if tuple(cand.agent_addr) == pref:
+                        lease = cand
+                        break
+                if lease is None:
+                    # no lease on the holder: hold the task back rather
+                    # than binding it to the wrong node.  First
+                    # encounter defers unconditionally — requeueing
+                    # makes the deficit loop below fire a lease request
+                    # whose locality routing targets the holder (an
+                    # existing warm lease elsewhere must not swallow
+                    # the task before pick_node ever sees it).  After
+                    # that, keep deferring only while requests are in
+                    # flight, within the deadline — bounded, so a
+                    # saturated holder can only delay it, never strand
+                    # it
+                    first = task.defer_deadline == 0.0
+                    if first:
+                        task.defer_deadline = now + _LOCALITY_DEFER_S
+                    if now < task.defer_deadline \
+                            and (first or state.inflight_requests > 0):
+                        deferred.append(task)
+                        continue
+            if lease is None:
+                lease = min(candidates, key=lambda l: len(l.inflight))
             lease.inflight.append(task)
             batches.setdefault(id(lease), (lease, []))[1].append(task)
+        if deferred:
+            state.pending.extendleft(reversed(deferred))
+            if not state.defer_timer:
+                # deadline-driven re-pump: without it a request queued
+                # 30s at a busy holder would strand deferred tasks past
+                # their bound until the next unrelated pump event
+                state.defer_timer = True
+                wake = min(t.defer_deadline for t in deferred)
+
+                def _expire():
+                    state.defer_timer = False
+                    self._pump(state)
+
+                self._loop().call_later(max(0.0, wake - now) + 0.01, _expire)
         for lease, tasks in batches.values():
             if len(tasks) == 1:
                 self._spawn(self._push(state, lease, tasks[0]))
@@ -1695,12 +1807,17 @@ class CoreWorker(RpcHost):
                 if not lease.inflight and not lease.dead:
                     self._park_lease(state, lease)
             return
-        # request more leases if there is unmet demand
+        # request more leases if there is unmet demand; each request
+        # carries a DISTINCT pending task's spec (not head-of-queue N
+        # times) so their locality hints route leases to each task's
+        # holder instead of piling every lease on the first task's node
         deficit = len(state.pending) - state.inflight_requests
         capacity = _MAX_LEASES_PER_CLASS - len(state.leases) - state.inflight_requests
         for _ in range(max(0, min(deficit, capacity))):
             state.inflight_requests += 1
-            self._spawn(self._request_lease(state, state.pending[0].spec))
+            spec = state.pending[state.req_rr % len(state.pending)].spec
+            state.req_rr += 1
+            self._spawn(self._request_lease(state, spec))
 
     async def _cancel_lease_request(self, rid: str, addr: Tuple[str, int]):
         try:
@@ -2051,6 +2168,8 @@ class CoreWorker(RpcHost):
             elif "stored" in r:
                 node = tuple(r["stored"]["node"])
                 self._locations[oid] = node
+                if r["stored"].get("size"):
+                    self._obj_sizes[oid] = r["stored"]["size"]
                 if task.spec.kind == NORMAL_TASK:
                     self._record_lineage(task, oid)
                 self.memory.set_in_plasma(oid, node)
@@ -2859,7 +2978,8 @@ class CoreWorker(RpcHost):
                     self.plasma.put_serialized(oid, frames, size,
                                                primary=True)
                     wire = {"stored": {"oid": oid,
-                                       "node": list(self.agent_addr)}}
+                                       "node": list(self.agent_addr),
+                                       "size": size}}
                 if conn is not None:
                     # ordered: item posts and the final reply post (see
                     # _post_exec_reply) ride the SAME coalesced FIFO
@@ -2986,7 +3106,9 @@ class CoreWorker(RpcHost):
                 results.append({"v": bytes(blob)})
             else:
                 self.plasma.put_serialized(oid, frames, size, primary=True)
-                results.append({"stored": {"oid": oid, "node": list(self.agent_addr)}})
+                results.append({"stored": {"oid": oid,
+                                           "node": list(self.agent_addr),
+                                           "size": size}})
         borrows = [oid for oid in arg_ref_oids if self.rc.count(oid) > 0]
         reply: Dict[str, Any] = {"results": results}
         if borrows:

@@ -53,7 +53,8 @@ class TestTaskSpec:
         spec = TaskSpec(
             task_id="ab" * 12, job_id="01020304", function_id="ff" * 8,
             args=[WireArg(value=b"inline"),
-                  WireArg(object_id="cd" * 14, owner_addr=("127.0.0.1", 9000)),
+                  WireArg(object_id="cd" * 14, owner_addr=("127.0.0.1", 9000),
+                          size=4 * 1024 * 1024, loc=("10.0.0.2", 7001)),
                   WireArg(value=b"kwv", kw="key")],
             num_returns=2, resources={"CPU": 1, "TPU": 0.5},
             actor_id="ee" * 8, method_name="step", seqno=7,
@@ -67,6 +68,9 @@ class TestTaskSpec:
         assert back.args[0].value == b"inline"
         assert back.args[1].object_id == "cd" * 14
         assert back.args[1].owner_addr == ("127.0.0.1", 9000)
+        assert back.args[1].size == 4 * 1024 * 1024
+        assert back.args[1].loc == ("10.0.0.2", 7001)
+        assert back.args[0].loc is None and back.args[0].size == 0
         assert back.args[2].kw == "key"
         assert back.resources == {"CPU": 1, "TPU": 0.5}
         assert back.owner_addr == ("10.0.0.1", 1234)
@@ -155,3 +159,95 @@ class TestHybridPolicy:
         c = self._cluster()
         c["tpu-node"] = NodeResources(ResourceSet({"CPU": 1, "TPU": 8}))
         assert pick_node(c, ResourceSet({"TPU": 4}), "n1") == "tpu-node"
+
+
+class TestLocalityScoring:
+    MB = 1024 * 1024
+
+    def _cluster(self):
+        return {nid: NodeResources(ResourceSet({"CPU": 4}))
+                for nid in ("n1", "n2", "n3")}
+
+    def test_holder_beats_local_preference(self):
+        c = self._cluster()
+        # n1 is local, idle and under the spread threshold — without
+        # locality it would win; the argument bytes on n3 override that
+        pick = pick_node(c, ResourceSet({"CPU": 1}), "n1",
+                         arg_bytes_by_node={"n3": 8 * self.MB},
+                         locality_min_bytes=self.MB)
+        assert pick == "n3"
+
+    def test_below_threshold_falls_back_to_hybrid(self):
+        c = self._cluster()
+        pick = pick_node(c, ResourceSet({"CPU": 1}), "n1",
+                         arg_bytes_by_node={"n3": self.MB // 2},
+                         locality_min_bytes=self.MB)
+        assert pick == "n1"  # hybrid local preference
+
+    def test_most_bytes_wins(self):
+        c = self._cluster()
+        pick = pick_node(c, ResourceSet({"CPU": 1}), "n1",
+                         arg_bytes_by_node={"n2": 2 * self.MB,
+                                            "n3": 16 * self.MB},
+                         locality_min_bytes=self.MB)
+        assert pick == "n3"
+
+    def test_tie_breaks_toward_colder_node(self):
+        c = self._cluster()
+        c["n2"].acquire(ResourceSet({"CPU": 2}))
+        pick = pick_node(c, ResourceSet({"CPU": 1}), "n1",
+                         arg_bytes_by_node={"n2": 4 * self.MB,
+                                            "n3": 4 * self.MB},
+                         locality_min_bytes=self.MB)
+        assert pick == "n3"
+
+    def test_full_but_feasible_holder_still_wins(self):
+        # skipping the transfer beats a short queue wait: a busy holder
+        # still receives the lease (queued demand triggers warm-lease
+        # reclaim there); only an INFEASIBLE holder falls back
+        c = self._cluster()
+        c["n3"].acquire(ResourceSet({"CPU": 4}))
+        pick = pick_node(c, ResourceSet({"CPU": 1}), "n1",
+                         arg_bytes_by_node={"n3": 8 * self.MB},
+                         locality_min_bytes=self.MB)
+        assert pick == "n3"
+
+    def test_available_holder_beats_fuller_holder(self):
+        c = self._cluster()
+        c["n3"].acquire(ResourceSet({"CPU": 4}))
+        pick = pick_node(c, ResourceSet({"CPU": 1}), "n1",
+                         arg_bytes_by_node={"n3": 8 * self.MB,
+                                            "n2": 4 * self.MB},
+                         locality_min_bytes=self.MB)
+        assert pick == "n2"  # fewer bytes but can run it NOW
+
+    def test_infeasible_holder_falls_back_to_hybrid(self):
+        c = self._cluster()
+        c["tpu"] = NodeResources(ResourceSet({"CPU": 4, "TPU": 4}))
+        # the holder can never run a TPU demand: hybrid policy decides
+        pick = pick_node(c, ResourceSet({"CPU": 1, "TPU": 1}), "n1",
+                         arg_bytes_by_node={"n3": 8 * self.MB},
+                         locality_min_bytes=self.MB)
+        assert pick == "tpu"
+
+    def test_strategy_overrides_unaffected(self):
+        c = self._cluster()
+        hints = {"n3": 8 * self.MB}
+        assert pick_node(c, ResourceSet({"CPU": 1}), "n1",
+                         strategy={"type": "node_affinity", "node_id": "n2"},
+                         arg_bytes_by_node=hints,
+                         locality_min_bytes=self.MB) == "n2"
+        rng = random.Random(0)
+        spread = {pick_node(c, ResourceSet({"CPU": 1}), "n1", rng=rng,
+                            strategy={"type": "spread"},
+                            arg_bytes_by_node=hints,
+                            locality_min_bytes=self.MB)
+                  for _ in range(20)}
+        assert spread == {"n1", "n2", "n3"}  # least-utilized, ignores bytes
+
+    def test_unknown_holder_node_ignored(self):
+        c = self._cluster()
+        pick = pick_node(c, ResourceSet({"CPU": 1}), "n1",
+                         arg_bytes_by_node={"dead-node": 64 * self.MB},
+                         locality_min_bytes=self.MB)
+        assert pick == "n1"

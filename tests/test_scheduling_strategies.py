@@ -77,7 +77,14 @@ def test_spread_uses_both_nodes(two_node):
     @ray_tpu.remote(scheduling_strategy="SPREAD", num_cpus=1)
     def where():
         import os as _os
+        import time as _time
 
+        # long enough that the execution-time depth curve keeps the
+        # pipeline at depth 1: a batch then NEEDS several leases, so
+        # spread exercises both nodes every round instead of the whole
+        # batch riding whichever single lease granted first (the first
+        # batch's node set used to freeze for the rest of the test)
+        _time.sleep(0.2)
         return _os.environ["RT_NODE_ID"]
 
     import time as _t
