@@ -343,6 +343,75 @@ def bench_serve(ray_tpu, pairs=2, conns=64, total=1200):
                 pass
     return out
 
+def bench_dag(ray_tpu, pairs=2, n=400, depth=8):
+    """Compiled-graph phases: a 3-stage actor chain executed through the
+    channel-compiled path (pinned actor loops over mutable shm channels,
+    zero per-call task submission) vs the dynamic CompiledDAG baseline
+    (real task submission per stage per execute), alternating pairs and
+    reporting BEST-OF per the slow-box protocol.  The contract is
+    `dag_vs_dynamic` >= 5x.  `dag_execute_p99_ms` comes from serial
+    execute+get round trips on the compiled path."""
+    from collections import deque
+
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    class Stage:
+        def step(self, x):
+            return x + 1
+
+    def build():
+        with InputNode() as inp:
+            out = inp
+            for _ in range(3):
+                out = Stage.bind().step.bind(out)
+        return out
+
+    def measure(use_channels):
+        c = build().experimental_compile(max_in_flight=depth,
+                                         use_channels=use_channels)
+        get = (lambda ref: ref.get(timeout=60)) if use_channels \
+            else (lambda ref: ray_tpu.get(ref, timeout=60))
+        try:
+            for _ in range(20):  # warm: leases/loops + channel attach
+                get(c.execute(0))
+            window = deque()  # keep `depth` executes in flight
+            t0 = time.perf_counter()
+            for i in range(n):
+                if len(window) >= depth:
+                    get(window.popleft())
+                window.append(c.execute(i))
+            while window:
+                get(window.popleft())
+            rate = n / (time.perf_counter() - t0)
+            lats = []
+            for i in range(200):
+                t1 = time.perf_counter()
+                get(c.execute(i))
+                lats.append(time.perf_counter() - t1)
+            lats.sort()
+            p99 = lats[min(len(lats) - 1, int(0.99 * len(lats)))] * 1000.0
+            return rate, p99
+        finally:
+            c.teardown()
+
+    comp_rates, dyn_rates, comp_p99 = [], [], []
+    for _ in range(pairs):
+        for use_channels in (False, True):
+            rate, p99 = measure(use_channels)
+            if use_channels:
+                comp_rates.append(rate)
+                comp_p99.append(p99)
+            else:
+                dyn_rates.append(rate)
+    best, base = max(comp_rates), max(dyn_rates)
+    return {
+        "dag_execute_per_s": round(best, 1),
+        "dag_execute_dynamic_per_s": round(base, 1),
+        "dag_vs_dynamic": round(best / base, 2),
+        "dag_execute_p99_ms": round(min(comp_p99), 3),
+    }
+
 def bench_small_ops(ray_tpu, n=1000):
     """Small-object put/get ops/s (reference: ray_perf.py:120-122,
     'single client get/put' — 10,181.6 / 5,545.0 ops/s recorded)."""
@@ -632,6 +701,7 @@ def main():
             "pg_create_remove_per_s", round(bench_pg_churn(ray_tpu), 1)))
         phase("put", lambda: extras.__setitem__(
             "put_gb_per_s", round(bench_put_gbps(ray_tpu), 2)))
+        phase("dag", lambda: extras.update(bench_dag(ray_tpu)))
         # burst-sequence + multi-client phases LAST among task phases:
         # the sync burst is deliberate history pollution, and proving the
         # earlier numbers unaffected by ordering is part of the contract

@@ -81,6 +81,13 @@ _def("serve_max_inflight_requests", 1024)  # proxy-wide gate; 503 beyond
 _def("serve_max_header_bytes", 65536)      # request line + headers cap (431)
 _def("serve_max_body_bytes", 32 * 1024 * 1024)  # request body cap (413)
 _def("serve_pipeline_depth", 32)  # pipelined requests per connection
+# --- compiled-DAG channels (see dag/channel.py + dag/execution.py) -----------
+_def("dag_channel_buffer_bytes", 1024 * 1024)  # per-version payload capacity
+_def("dag_channel_poll_max_s", 0.002)  # backoff cap while polling a channel
+_def("dag_monitor_interval_s", 0.2)    # driver loop-ref death-watch cadence;
+# bounds how long in-flight CompiledDAGRef.get() calls can hang past an
+# actor death before they raise
+_def("dag_teardown_timeout_s", 10.0)
 # --- distributed tracing (see _private/tracing.py) ---------------------------
 _def("tracing_enabled", True)
 _def("trace_sampling_ratio", 1.0)      # root-span sampling probability

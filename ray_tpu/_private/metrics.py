@@ -277,6 +277,30 @@ def object_transfer_metrics() -> Tuple[Counter, Histogram]:
     return _xfer_metrics
 
 
+_dag_metrics: Optional[Tuple[Histogram, Counter]] = None
+
+
+def dag_metrics() -> Tuple[Histogram, Counter]:
+    """Process-singleton compiled-DAG metrics (dag/execution.py +
+    dag/channel.py): ``ray_tpu_dag_execute_latency_seconds`` — wall
+    time from ``CompiledGraph.execute()`` to the result landing in
+    ``CompiledDAGRef.get()``, observed driver-side — and
+    ``ray_tpu_dag_channel_ops_total`` — channel version reads/writes
+    plus executes, labeled by op=read|write|execute.  Drivers and actor
+    workers each export through the standard worker→node-agent push."""
+    global _dag_metrics
+    if _dag_metrics is None:
+        _dag_metrics = (
+            Histogram("ray_tpu_dag_execute_latency_seconds",
+                      "compiled-DAG execute-to-result latency",
+                      boundaries=[0.0002, 0.0005, 0.001, 0.0025, 0.005,
+                                  0.01, 0.025, 0.05, 0.1, 0.25, 1, 5, 30]),
+            Counter("ray_tpu_dag_channel_ops_total",
+                    "compiled-DAG channel version operations"),
+        )
+    return _dag_metrics
+
+
 _serve_request_latency: Optional[Histogram] = None
 
 

@@ -435,6 +435,81 @@ class NodeAgent(RpcHost):
     async def rpc_store_usage(self):
         return self.store.usage()
 
+    # ---- compiled-DAG channels (see dag/channel.py) ------------------------
+    # A channel slot is a reusable pinned shm allocation: the writer-node
+    # slot plus one mirror per remote reader node, all under the same
+    # oid.  Version bytes normally arrive over the bulk transfer plane
+    # (write-flagged range requests straight into the arena); the
+    # channel_write/channel_read RPCs are the compat path for peers
+    # without a reachable transfer listener.
+
+    def _channel_entry(self, oid: str):
+        entry = self.store.objects.get(oid)
+        if entry is None or not entry.channel:
+            return None
+        return entry
+
+    async def rpc_channel_create(self, oid: str, size: int,
+                                 header: Dict[str, Any]):
+        from ray_tpu.dag import channel as chmod
+
+        loc = self.store.create_channel(oid, size)
+        view = self.store.arena.view[loc["offset"]:loc["offset"] + size]
+        if int.from_bytes(view[0:8], "little") != chmod.MAGIC:
+            chmod.init_view(view, header)
+        return {"ok": True, "offset": loc["offset"], "size": size}
+
+    async def rpc_channel_destroy(self, oid: str):
+        self.store.destroy_channel(oid)
+        return {"ok": True}
+
+    async def rpc_channel_map(self, oid: str):
+        """Local attach: a driver/worker on this node maps the slot
+        zero-copy out of the arena it already has mmap'd."""
+        entry = self._channel_entry(oid)
+        if entry is None:
+            return {"found": False}
+        return {"found": True, "offset": entry.offset, "size": entry.size}
+
+    async def rpc_channel_write(self, oid: str, offset: int, data: bytes):
+        """Compat push path: version bytes over control RPC when the
+        bulk plane cannot reach this node."""
+        entry = self._channel_entry(oid)
+        if entry is None:
+            return {"ok": False, "error": f"no channel {oid[:16]} here"}
+        if offset < 0 or offset + len(data) > entry.size:
+            return {"ok": False, "error": "write outside channel slot"}
+        base = entry.offset
+        self.store.arena.view[base + offset:base + offset + len(data)] = data
+        return {"ok": True}
+
+    async def rpc_channel_read(self, oid: str, offset: int, length: int):
+        entry = self._channel_entry(oid)
+        if entry is None:
+            return {"ok": False, "error": f"no channel {oid[:16]} here"}
+        if offset < 0 or length < 0 or offset + length > entry.size:
+            return {"ok": False, "error": "read outside channel slot"}
+        base = entry.offset
+        return {"ok": True,
+                "data": bytes(self.store.arena.view[base + offset:
+                                                    base + offset + length])}
+
+    async def rpc_channel_poison(self, oid: str, error: bytes = b"",
+                                 close_only: bool = False):
+        """Poison (actor death) or close (teardown) the local copy of a
+        channel, waking every blocked reader/writer on this node."""
+        from ray_tpu.dag import channel as chmod
+
+        entry = self._channel_entry(oid)
+        if entry is None:
+            return {"ok": False}
+        view = self.store.arena.view[entry.offset:entry.offset + entry.size]
+        if close_only:
+            chmod.close_view(view)
+        else:
+            chmod.poison_view(view, error)
+        return {"ok": True}
+
     # ---- object transfer (pull-based) --------------------------------------
     # Control (size lookup, pin/unpin) rides the msgpack RPC connection;
     # bytes ride the bulk plane (object_transfer.py) — a dedicated raw

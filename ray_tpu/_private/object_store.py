@@ -195,6 +195,11 @@ class _Entry:
     path: str = ""  # disk only
     sealed: bool = False
     primary: bool = True
+    # reusable pinned channel slot (compiled-DAG channels): permanently
+    # pinned, never spilled/evicted, excluded from the object directory,
+    # and writable in place after seal (single-writer ring discipline is
+    # enforced by the channel layer, not the store)
+    channel: bool = False
     created_at: float = field(default_factory=time.monotonic)
     last_used: float = field(default_factory=time.monotonic)
     pins: Dict[str, int] = field(default_factory=dict)  # client_id -> count
@@ -250,6 +255,49 @@ class StoreCore:
         self.objects[oid] = _Entry(size=size, location="disk", path=path,
                                    primary=primary)
         return {"location": "disk", "path": path, "size": size}
+
+    def create_channel(self, oid: str, size: int) -> Dict[str, Any]:
+        """Reserve a reusable pinned shm slot for a compiled-DAG channel
+        (writer-node slot or reader-node mirror).  Sealed immediately
+        (readers mmap it for the channel's whole life), permanently
+        pinned so reclaim can never spill or evict it, and zeroed so
+        stale arena bytes cannot masquerade as a published version.
+        Channels must live in shm — mirror pushes and zero-copy reads
+        write through the arena mapping — so an arena too full to hold
+        one raises instead of falling back to disk.  Idempotent per oid
+        (a retried compile reuses the slot)."""
+        entry = self.objects.get(oid)
+        if entry is not None:
+            if entry.channel and entry.size == size:
+                return {"location": "shm", "offset": entry.offset,
+                        "size": entry.size}
+            raise ObjectAlreadyExists(oid)
+        self._deleted.discard(oid)
+        offset = self.alloc.alloc(size)
+        if offset is None:
+            self._reclaim(size)
+            offset = self.alloc.alloc(size)
+        if offset is None:
+            raise ObjectStoreFull(
+                f"cannot allocate a {size}-byte channel slot; channels "
+                "require shm (lower max_in_flight / buffer_size_bytes or "
+                "grow object_store_memory)")
+        entry = _Entry(size=size, location="shm", offset=offset,
+                       primary=True, sealed=True, channel=True)
+        entry.pins["__channel__"] = 1
+        self.objects[oid] = entry
+        self.arena.view[offset:offset + size] = b"\0" * size
+        return {"location": "shm", "offset": offset, "size": size}
+
+    def destroy_channel(self, oid: str) -> None:
+        """Release a channel slot; no-op for unknown/non-channel oids."""
+        entry = self.objects.get(oid)
+        if entry is None or not entry.channel:
+            return
+        entry.pins.pop("__channel__", None)
+        self._deleted.add(oid)
+        if not entry.pinned:
+            self._drop(oid, entry)
 
     def seal(self, oid: str) -> None:
         entry = self.objects.get(oid)
@@ -352,7 +400,7 @@ class StoreCore:
         if min_bytes <= 0:
             return []
         out = [[oid, e.size] for oid, e in self.objects.items()
-               if e.sealed and e.size >= min_bytes
+               if e.sealed and e.size >= min_bytes and not e.channel
                and oid not in self._deleted]
         if len(out) > limit:
             out.sort(key=lambda p: -p[1])

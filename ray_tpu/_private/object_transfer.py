@@ -49,10 +49,16 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-_REQ = struct.Struct("<HQQ")   # oid_len, offset, length
+_REQ = struct.Struct("<HQQ")   # oid_len (| _WRITE_FLAG), offset, length
 _RSP = struct.Struct("<BQ")    # status, length
 _OK, _NOT_FOUND = 0, 1
 _MAX_REQ_OID = 256
+# high bit of oid_len marks a WRITE request: `length` payload bytes
+# follow the oid and are stored at [offset, offset+length) of the named
+# object.  Writes are only honored for channel slots (compiled-DAG
+# mutable channels, see dag/channel.py) — immutable objects stay
+# immutable on the wire.
+_WRITE_FLAG = 0x8000
 _IO_TIMEOUT_S = 60.0  # per socket op; a wedged peer must not pin a thread
 _POOL_IDLE_S = 30.0   # drop pooled streams before the holder's idle
 # timeout (_IO_TIMEOUT_S on its recv) can close them under us
@@ -61,6 +67,11 @@ _POOL_IDLE_S = 30.0   # drop pooled streams before the holder's idle
 class TransferError(Exception):
     """The holder could not serve a requested range (object vanished,
     stream died mid-transfer)."""
+
+
+class _Rejected(Exception):
+    """In-protocol refusal (status != OK) at a clean frame boundary —
+    the stream stays usable and the request must NOT be retried."""
 
 
 def _tune(sock: socket.socket) -> None:
@@ -105,6 +116,19 @@ def _recv_exact(sock: socket.socket, size: int,
             raise TransferError("transfer stream closed mid-frame")
         pos += n
     return buf
+
+
+def _discard(sock: socket.socket, length: int) -> None:
+    """Read and drop exactly `length` payload bytes through a small
+    fixed scratch buffer."""
+    scratch = bytearray(min(length, 256 * 1024))
+    view = memoryview(scratch)
+    left = length
+    while left > 0:
+        n = sock.recv_into(view[:min(left, len(scratch))])
+        if n == 0:
+            raise TransferError("transfer stream closed mid-payload")
+        left -= n
 
 
 class _MappedFile:
@@ -248,6 +272,20 @@ class ObjectTransferServer:
         if m is not None:
             m.close()
 
+    def channel_view(self, oid: str, offset: int,
+                     length: int) -> Optional[memoryview]:
+        """A writable view over a CHANNEL slot — the only entries the
+        push path may mutate.  Channels are permanently pinned and live
+        in shm, so the arena range is stable for the write."""
+        entry = self.store.objects.get(oid)
+        if entry is None or not getattr(entry, "channel", False) \
+                or entry.location != "shm":
+            return None
+        if offset < 0 or length < 0 or offset + length > entry.size:
+            return None
+        base = entry.offset
+        return self.store.arena.view[base + offset:base + offset + length]
+
     def _serve_conn(self, sock: socket.socket):
         fd = sock.fileno()
         try:
@@ -256,9 +294,23 @@ class ObjectTransferServer:
                 if hdr is None:
                     return
                 oid_len, offset, length = _REQ.unpack(hdr)
+                is_write = bool(oid_len & _WRITE_FLAG)
+                oid_len &= ~_WRITE_FLAG
                 if oid_len == 0 or oid_len > _MAX_REQ_OID:
                     raise TransferError(f"bad oid length {oid_len}")
                 oid = bytes(_recv_exact(sock, oid_len)).decode()
+                if is_write:
+                    view = self.channel_view(oid, offset, length)
+                    if view is None:
+                        # drain the payload (bounded scratch, never an
+                        # allocation of the peer-supplied length) to
+                        # keep stream framing sane
+                        _discard(sock, length)
+                        sock.sendall(_RSP.pack(_NOT_FOUND, 0))
+                        continue
+                    _recv_into(sock, view)
+                    sock.sendall(_RSP.pack(_OK, 0))
+                    continue
                 view = self.object_view(oid, offset, length)
                 if view is None:
                     sock.sendall(_RSP.pack(_NOT_FOUND, 0))
@@ -343,6 +395,65 @@ class ObjectTransferClient:
                 sock.close()
             except OSError:
                 pass
+
+    def _sync_round(self, request_fn):
+        """One blocking request/response round on a pooled stream,
+        retrying on a fresh stream when a POOLED one turns out dead
+        (channel range reads/writes are idempotent).  `request_fn(sock)`
+        returns the result, raising _Rejected for a clean in-protocol
+        refusal (frame boundary intact, stream reusable)."""
+        while True:
+            sock, fresh = self._checkout()
+            try:
+                result = request_fn(sock)
+                self._checkin(sock)
+                return result
+            except _Rejected as e:
+                self._checkin(sock)
+                raise TransferError(str(e)) from None
+            except (TransferError, OSError) as e:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                if fresh:
+                    if isinstance(e, socket.timeout):
+                        raise TransferError(f"transfer stalled: {e}") from e
+                    raise
+                # stale pooled stream: loop onto a fresher connection
+
+    def write_range(self, oid: str, offset: int, payload) -> None:
+        """Blocking channel push: store `payload` at [offset, ...) of a
+        CHANNEL slot on the holder (compiled-DAG mutable channels)."""
+        oid_b = oid.encode()
+
+        def round_(sock):
+            sock.sendall(_REQ.pack(len(oid_b) | _WRITE_FLAG, offset,
+                                   len(payload)) + oid_b)
+            sock.sendall(payload)
+            status, _n = _RSP.unpack(_recv_exact(sock, _RSP.size))
+            if status != _OK:
+                raise _Rejected(f"channel write to {oid[:16]} rejected "
+                                f"by {self.host}:{self.port}")
+
+        self._sync_round(round_)
+
+    def read_range(self, oid: str, offset: int, length: int) -> bytearray:
+        """Blocking single-range read (channel cursor words etc.)."""
+        oid_b = oid.encode()
+
+        def round_(sock):
+            sock.sendall(_REQ.pack(len(oid_b), offset, length) + oid_b)
+            status, n = _RSP.unpack(_recv_exact(sock, _RSP.size))
+            if status != _OK:
+                raise _Rejected(f"range of {oid[:16]} not served by "
+                                f"{self.host}:{self.port}")
+            if n != length:
+                raise TransferError(
+                    f"short range reply for {oid[:16]}: {n} != {length}")
+            return _recv_exact(sock, length)
+
+        return self._sync_round(round_)
 
     async def fetch_into(self, oid: str, dest: memoryview) -> None:
         """Pull the whole object into `dest` (len(dest) == object size):

@@ -2885,8 +2885,25 @@ class CoreWorker(RpcHost):
             if spec.kind == ACTOR_TASK:
                 if self._actor_instance is None:
                     raise ActorDiedError("actor instance not initialized")
-                fn = getattr(self._actor_instance, spec.method_name)
-                value = fn(*args, **kwargs)
+                if spec.method_name.startswith("__rt_dag_"):
+                    # compiled-DAG system methods (dag/execution.py):
+                    # the exec loop PINS this exec thread — it blocks on
+                    # its input channels and replays the actor's bound
+                    # methods until the graph is torn down
+                    from ray_tpu.dag import execution as _dag_exec
+
+                    if spec.method_name == _dag_exec.DAG_INFO_METHOD:
+                        value = _dag_exec.collect_node_info(self)
+                    elif spec.method_name == _dag_exec.DAG_EXEC_METHOD:
+                        value = _dag_exec.run_actor_loop(
+                            self, self._actor_instance, *args)
+                    else:
+                        raise AttributeError(
+                            f"unknown compiled-DAG system method "
+                            f"{spec.method_name!r}")
+                else:
+                    fn = getattr(self._actor_instance, spec.method_name)
+                    value = fn(*args, **kwargs)
             else:
                 fn = self.functions.fetch(spec.function_id)
                 value = fn(*args, **kwargs)

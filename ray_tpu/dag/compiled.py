@@ -35,6 +35,7 @@ class CompiledDAG:
         self._order = root.topological()
         self._max_in_flight = max_in_flight
         self._in_flight: List[Any] = []
+        self._torn_down = False
         inputs = [n for n in self._order if isinstance(n, InputNode)]
         if len(inputs) > 1:
             raise ValueError("a DAG can reference at most one InputNode")
@@ -62,6 +63,10 @@ class CompiledDAG:
         unfinished."""
         import ray_tpu
 
+        if self._torn_down:
+            raise ray_tpu.RayError(
+                "this CompiledDAG has been torn down; rebuild and "
+                "recompile the DAG to execute again")
         self._apply_backpressure(ray_tpu)
         memo: Dict[int, Any] = dict(self._plan_memo)
         for node in self._order:
@@ -83,21 +88,54 @@ class CompiledDAG:
                                if not all(r in done for r in g)]
         while len(self._in_flight) >= self._max_in_flight:
             oldest = self._in_flight[0]
-            ray_tpu.wait(oldest, num_returns=len(oldest), timeout=300)
+            # short wait rounds instead of one 300s block: a DAG actor
+            # dying mid-pipeline resolves the oldest group's refs with
+            # ActorDiedError, which must surface here — silently
+            # re-blocking would wedge the caller for minutes per round
             ready, _ = ray_tpu.wait(oldest, num_returns=len(oldest),
-                                    timeout=0)
-            if len(ready) == len(oldest):
-                self._in_flight.pop(0)
-            # else: stragglers past the wait timeout — keep the group so
-            # the cap stays real, and block again
+                                    timeout=1.0)
+            if len(ready) < len(oldest):
+                continue  # stragglers: the cap stays real, block again
+            self._in_flight.pop(0)
+            try:
+                ray_tpu.get(ready, timeout=0)
+            except ray_tpu.ActorDiedError:
+                raise
+            except Exception:
+                # app-level task errors keep dynamic-execute semantics:
+                # they surface at the caller's own get(), not here
+                pass
 
-    def teardown(self):
-        """Kill the plan's actors."""
+    def teardown(self, timeout: float = 10.0):
+        """Kill the plan's actors and wait for them to die.  Synchronous
+        and idempotent: a second call (or a call after the actors have
+        already crashed) is a no-op, and ``execute()`` afterwards raises
+        instead of replaying over dead actors."""
+        if self._torn_down:
+            return
+        self._torn_down = True
+        import time as _time
+
         import ray_tpu
+        from ray_tpu import api as _api
 
-        for handle in self._actors.values():
+        from ray_tpu._private.rpc import ConnectionLost, RpcError
+
+        actors, self._actors = self._actors, {}
+        for handle in actors.values():
             try:
                 ray_tpu.kill(handle)
-            except Exception:
-                pass
-        self._actors.clear()
+            except (ray_tpu.RayError, RpcError, ConnectionLost, OSError):
+                pass  # already dead / cluster shutting down
+        w = _api._worker()
+        deadline = _time.monotonic() + timeout
+        for handle in actors.values():
+            while _time.monotonic() < deadline:
+                try:
+                    info = w.head.call("get_actor_info",
+                                       actor_id=handle._actor_id)
+                except Exception:
+                    return  # head unreachable: nothing left to wait on
+                if info.get("state") == "DEAD":
+                    break
+                _time.sleep(0.05)

@@ -102,7 +102,28 @@ class DAGNode:
 
     # --------------------------------------------------------------- compile
 
-    def experimental_compile(self, max_in_flight: int = 8):
+    def experimental_compile(self, max_in_flight: int = 8,
+                             use_channels: bool = False,
+                             buffer_size_bytes: Optional[int] = None):
+        """Freeze this DAG into a replayable plan.
+
+        ``use_channels=False`` (default) returns the dynamic
+        :class:`~ray_tpu.dag.compiled.CompiledDAG`: actors are created
+        once, but every ``execute()`` still submits real tasks.
+
+        ``use_channels=True`` returns a
+        :class:`~ray_tpu.dag.execution.CompiledGraph`: actor-method
+        graphs replay over pre-allocated mutable shm channels with a
+        pinned per-actor execution loop — no per-call task submission,
+        scheduling, or object refs (``execute()`` hands back a
+        ``CompiledDAGRef``; call ``.get()`` on it, not ``ray_tpu.get``).
+        ``buffer_size_bytes`` overrides the per-version channel payload
+        capacity (config ``dag_channel_buffer_bytes``)."""
+        if use_channels:
+            from ray_tpu.dag.execution import CompiledGraph
+
+            return CompiledGraph(self, max_in_flight=max_in_flight,
+                                 buffer_size_bytes=buffer_size_bytes)
         from ray_tpu.dag.compiled import CompiledDAG
 
         return CompiledDAG(self, max_in_flight=max_in_flight)
