@@ -182,6 +182,44 @@ def bench_trace_overhead(ray_tpu, n=1500, pairs=3):
         "trace_overhead_pct": round(100.0 * (off - on) / off, 2),
     }
 
+def bench_profile_overhead(ray_tpu, n=1200, pairs=2):
+    """Sampling-profiler cost phase: async task throughput with the
+    in-process sampler running at the default hz on the DRIVER (the
+    submit hot path — the process an operator would actually profile
+    while hunting the tasks/s plateau) vs. not running, as a percent
+    throughput loss.  BEST-OF alternating pairs per the slow-box
+    protocol, same as trace_overhead.  Budget: < 5% at
+    profiler_default_hz — the profiler must be cheap enough to switch
+    on against a production incident."""
+    from ray_tpu._private import profiling
+
+    @ray_tpu.remote
+    def e():
+        return b"ok"
+
+    def measure():
+        ray_tpu.get([e.remote() for _ in range(100)], timeout=60)  # warm
+        t0 = time.perf_counter()
+        ray_tpu.get([e.remote() for _ in range(n)], timeout=120)
+        return n / (time.perf_counter() - t0)
+
+    on_rates, off_rates = [], []
+    for _ in range(pairs):
+        off_rates.append(measure())
+        started = profiling.start_sampler()
+        try:
+            on_rates.append(measure())
+        finally:
+            if started.get("ok"):
+                profiling.stop_sampler()
+    on, off = max(on_rates), max(off_rates)
+    return {
+        "profiled_async_per_s": round(on, 1),
+        "unprofiled_async_per_s": round(off, 1),
+        # negative = profiler measured faster (noise); report as-is
+        "profile_overhead_pct": round(100.0 * (off - on) / off, 2),
+    }
+
 def _serve_http_get(host, port, conns, total, path, timeout_s=120):
     """Drive the Serve proxy with `conns` keep-alive connections issuing
     `total` GET requests between them; returns (rps, p99_ms)."""
@@ -707,6 +745,8 @@ def main():
         # earlier numbers unaffected by ordering is part of the contract
         phase("trace_overhead", lambda: extras.update(
             bench_trace_overhead(ray_tpu)))
+        phase("profile_overhead", lambda: extras.update(
+            bench_profile_overhead(ray_tpu)))
         phase("burst_async", lambda: extras.__setitem__(
             "burst_async_per_s", round(bench_burst_then_async(ray_tpu), 1)))
         phase("multi_client", lambda: extras.__setitem__(

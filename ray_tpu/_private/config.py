@@ -76,6 +76,14 @@ _def("memory_monitor_test_usage_file", "")    # test hook: fraction in a file
 _def("task_events_buffer_size", 10_000)
 _def("metrics_report_interval_ms", 5_000)
 _def("event_stats", True)
+# --- live introspection (see _private/profiling.py + log_monitor.py) ---------
+_def("profiler_default_hz", 99)            # sampling rate when none given
+_def("profiler_max_duration_s", 300.0)     # hard cap on one profile run
+_def("loop_lag_probe_interval_ms", 500)    # event-loop lag probe cadence
+_def("log_monitor_poll_ms", 250)           # agent-side log tail cadence
+_def("log_monitor_max_read_bytes", 256 * 1024)  # per file per poll
+_def("log_to_driver", True)                # stream worker logs to drivers
+_def("timeseries_max_samples", 240)        # head ring depth per series
 # --- serve data plane (see serve/http.py) ------------------------------------
 _def("serve_max_inflight_requests", 1024)  # proxy-wide gate; 503 beyond
 _def("serve_max_header_bytes", 65536)      # request line + headers cap (431)

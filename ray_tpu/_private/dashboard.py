@@ -80,12 +80,14 @@ style="color:var(--muted);font-size:12px"></span>
 APP_JS = r"""// ray_tpu dashboard app (single file, no build step)
 "use strict";
 let SNAP = null;
+let TSERIES = null;  // /api/timeseries: head + per-agent gauge rings
 let TAB = "nodes";
 let TASK_FILTER = "";
 
 const TABS = [
   ["nodes", "Nodes"], ["actors", "Actors"], ["tasks", "Tasks"],
   ["pgs", "Placement groups"], ["jobs", "Jobs"], ["traces", "Traces"],
+  ["series", "Series"],
 ];
 
 function el(tag, attrs, ...children) {
@@ -222,6 +224,20 @@ const VIEWS = {
       t.root || "", t.num_spans,
       (t.duration_s * 1000).toFixed(1) + " ms",
     ])),
+  // head time-series ring (/api/timeseries): loop lag and health
+  // gauges per node, one sparkline tile per series
+  series: () => {
+    const rows = (TSERIES && TSERIES.series) || [];
+    if (!rows.length) return el("div", {class: "empty"},
+                                "no samples yet (first heartbeat pending)");
+    return el("div", {class: "tiles"}, ...rows.map(r => {
+      const vals = r.points.map(p => p[1]);
+      const last = vals.length ? vals[vals.length - 1] : 0;
+      const shown = Math.abs(last) < 1 && last !== 0
+        ? last.toExponential(2) : String(Math.round(last * 1000) / 1000);
+      return tile(`${r.name} @ ${r.node}`, shown, vals);
+    }));
+  },
 };
 
 function render() {
@@ -241,7 +257,8 @@ function render() {
   tabs.replaceChildren(...TABS.map(([id, label]) => {
     const counts = {nodes: s.nodes.length, actors: s.actors.length,
                     tasks: s.tasks.length, pgs: s.placement_groups.length,
-                    jobs: s.jobs.length, traces: (s.traces || []).length};
+                    jobs: s.jobs.length, traces: (s.traces || []).length,
+                    series: ((TSERIES && TSERIES.series) || []).length};
     const b = el("button", {class: id === TAB ? "active" : "",
                             onclick: () => { TAB = id; render(); }},
                  `${label} (${counts[id]})`);
@@ -256,6 +273,9 @@ async function refresh() {
   try {
     const r = await fetch("/api/snapshot");
     SNAP = await r.json();
+    try {
+      TSERIES = await (await fetch("/api/timeseries")).json();
+    } catch (e) { /* series tab degrades to empty */ }
     document.getElementById("error").style.display = "none";
     render();
   } catch (e) {

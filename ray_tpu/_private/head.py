@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private.config import config
 from ray_tpu._private.ids import JobID
+from ray_tpu._private.profiling import IntrospectionRpcMixin, loop_lag_probe
 from ray_tpu._private.resources import NodeResources, ResourceSet
 from ray_tpu._private.rpc import RpcClient, RpcHost, RpcServer, RpcError
 from ray_tpu._private.scheduler import pick_node
@@ -172,7 +173,7 @@ class _NodeEntry:
         }
 
 
-class HeadService(RpcHost):
+class HeadService(IntrospectionRpcMixin, RpcHost):
     def __init__(self, state_path: str = ""):
         self.nodes: Dict[str, _NodeEntry] = {}
         self.kv: Dict[str, bytes] = {}
@@ -232,6 +233,12 @@ class HeadService(RpcHost):
 
         self._dash_series = _deque(maxlen=150)
         self._dash_task: Optional[asyncio.Task] = None
+        # time-series store: (node, metric) -> bounded ring of (ts, value)
+        # fed by per-agent heartbeat summaries + the head's own sampler,
+        # served at /api/timeseries and `rtpu status --watch`
+        self._tseries: Dict[Tuple[str, str], Any] = {}
+        self._head_loop_lag = 0.0
+        self._lag_task: Optional[asyncio.Task] = None
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -241,6 +248,12 @@ class HeadService(RpcHost):
         self._server = RpcServer(self, host, port)
         p = await self._server.start()
         self._health_task = asyncio.ensure_future(self._health_loop())
+
+        def _lag(sample: float) -> None:
+            self._head_loop_lag = sample
+
+        self._lag_task = asyncio.ensure_future(
+            loop_lag_probe("head", on_sample=_lag))
         if self._state_path:
             self._persist_task = asyncio.ensure_future(self._persist_loop())
         await self._start_metrics(host)
@@ -256,6 +269,8 @@ class HeadService(RpcHost):
     async def stop(self):
         if self._health_task:
             self._health_task.cancel()
+        if self._lag_task:
+            self._lag_task.cancel()
         if self._persist_task:
             self._persist_task.cancel()
         if self._dash_task:
@@ -451,11 +466,16 @@ class HeadService(RpcHost):
     async def rpc_heartbeat(self, node_id: str, available: Dict[str, float],
                             pending: Optional[List[Dict[str, float]]] = None,
                             objects: Optional[List[List[Any]]] = None,
-                            seen_dir_version: int = -1):
+                            seen_dir_version: int = -1,
+                            metrics: Optional[Dict[str, float]] = None):
         entry = self.nodes.get(node_id)
         if entry is None:
             return {"unknown_node": True}
         entry.last_heartbeat = time.monotonic()
+        if metrics:
+            now = time.time()
+            for name, value in metrics.items():
+                self._ts_record(node_id[:12], str(name), value, now)
         fresh = ResourceSet(available)
         changed = fresh != entry.resources.available
         entry.resources.available = fresh
@@ -580,6 +600,8 @@ class HeadService(RpcHost):
         entry = self.nodes.pop(node_id, None)
         if entry is None:
             return
+        for key in [k for k in self._tseries if k[0] == node_id[:12]]:
+            self._tseries.pop(key, None)  # dead node: drop its series
         self._cluster_version += 1
         self.mark_dirty()
         self.publish("node_events", {"event": "dead", "node_id": node_id,
@@ -1291,6 +1313,18 @@ class HeadService(RpcHost):
         try:
             from ray_tpu._private import dashboard as _dash
 
+            # /api/stack and /api/profile fan out over RPC: async route
+            # handlers awaited by the server, with the query string
+            # passed through (wants_query)
+            def stack_route(query: str = ""):
+                return self._http_stack(query)
+
+            stack_route.wants_query = True
+
+            def profile_route(query: str = ""):
+                return self._http_profile(query)
+
+            profile_route.wants_query = True
             self._metrics_server, self.metrics_port = \
                 await start_metrics_http_server(
                     default_registry, host,
@@ -1306,6 +1340,9 @@ class HeadService(RpcHost):
                         # trailing slash = prefix route: the suffix is
                         # passed in (/api/traces/<trace_id>)
                         "/api/traces/": self._render_one_trace_json,
+                        "/api/timeseries": self._render_timeseries_json,
+                        "/api/stack": stack_route,
+                        "/api/profile": profile_route,
                     })
             self._dash_task = asyncio.ensure_future(self._dash_sample_loop())
         except Exception:
@@ -1345,13 +1382,16 @@ class HeadService(RpcHost):
                    if r.get("state") in ("FINISHED", "FAILED"))
 
     async def _dash_sample_loop(self):
-        """Every 2s append one sample to the sparkline ring (~5 min)."""
+        """Every 2s append one sample to the sparkline ring (~5 min),
+        and fold the head's own gauges into the time-series store next
+        to the per-agent heartbeat summaries."""
         last_finished = self._tasks_finished_total()
         while True:
             await asyncio.sleep(2.0)
             try:
                 avail, total = self._cpu_totals()
                 finished = self._tasks_finished_total()
+                task_rate = max(0, finished - last_finished)
                 self._dash_series.append({
                     "ts": time.time(),
                     "nodes": len(self.nodes),
@@ -1360,9 +1400,15 @@ class HeadService(RpcHost):
                                         if a.state == ALIVE),
                     # events roll off the capped store, so the delta can
                     # dip negative on truncation — clamp
-                    "task_rate": max(0, finished - last_finished),
+                    "task_rate": task_rate,
                 })
                 last_finished = finished
+                now = time.time()
+                self._ts_record("head", "loop_lag_seconds",
+                                self._head_loop_lag, now)
+                self._ts_record("head", "nodes", len(self.nodes), now)
+                self._ts_record("head", "cpus_avail", avail, now)
+                self._ts_record("head", "task_rate", task_rate, now)
             except Exception:
                 pass
 
@@ -1571,6 +1617,134 @@ class HeadService(RpcHost):
             body = _json.dumps({"error": f"no trace {trace_id!r}"})
             return "application/json", body.encode()
         return "application/json", _json.dumps(trace, default=str).encode()
+
+    # ---- live introspection (see _private/profiling.py): cluster-wide
+    # stack dumps, routed sampling profiles, and the head time-series
+    # ring behind /api/timeseries (reference roles: `ray stack`,
+    # profile_manager.py, and the dashboard's node-stats timeline) ---------
+
+    def _ts_record(self, node: str, name: str, value: float,
+                   ts: Optional[float] = None) -> None:
+        key = (node, name)
+        dq = self._tseries.get(key)
+        if dq is None:
+            from collections import deque as _deque
+
+            dq = self._tseries[key] = _deque(
+                maxlen=int(config.timeseries_max_samples))
+        try:
+            dq.append((ts if ts is not None else time.time(), float(value)))
+        except (TypeError, ValueError):
+            pass
+
+    def _timeseries_payload(self) -> Dict[str, Any]:
+        return {"series": [
+            {"node": node, "name": name,
+             "points": [[round(ts, 3), v] for ts, v in dq]}
+            for (node, name), dq in sorted(self._tseries.items())]}
+
+    async def rpc_timeseries(self):
+        return self._timeseries_payload()
+
+    def _render_timeseries_json(self):
+        import json as _json
+
+        return "application/json", _json.dumps(
+            self._timeseries_payload()).encode()
+
+    async def rpc_cluster_stack(self, target: str = "",
+                                timeout_s: float = 5.0):
+        """Live stack dumps across the cluster: the head process plus
+        every agent's node_stacks fan-out (agent + its pooled workers).
+        ``target`` filters to one node by id prefix, or to "head"."""
+        from ray_tpu._private.profiling import proc_stack_payload
+
+        out: Dict[str, Any] = {"nodes": {}}
+        if not target or target == "head":
+            out["head"] = proc_stack_payload()
+        if target == "head":
+            return out
+
+        async def one(node: _NodeEntry):
+            try:
+                out["nodes"][node.node_id] = await self._node_client(
+                    node).call("node_stacks", timeout_s=timeout_s,
+                               timeout=timeout_s + 5.0)
+            except Exception as e:
+                out["nodes"][node.node_id] = {
+                    "error": f"{type(e).__name__}: {e}"}
+
+        nodes = list(self.nodes.values())
+        if target:
+            matched = [n for n in nodes if n.node_id.startswith(target)]
+            # a worker-id target matches no node: fan out everywhere and
+            # let the caller filter its workers by id prefix
+            nodes = matched or nodes
+        await asyncio.gather(*(one(n) for n in nodes))
+        return out
+
+    async def rpc_profile_target(self, target: str = "head", hz: float = 0,
+                                 duration_s: float = 2.0,
+                                 fmt: str = "collapsed"):
+        """Route a sampling-profiler run to a process: "head", a node id
+        prefix (profiles that node's agent), or a worker id prefix
+        (proxied by the agent that pools it).  Blocks for the duration
+        and returns the collapsed/speedscope output."""
+        duration_s = min(float(duration_s),
+                         float(config.profiler_max_duration_s))
+        if not target or target == "head":
+            return await self.rpc_profile(op="run", hz=hz,
+                                          duration_s=duration_s, fmt=fmt)
+        node = next((n for n in self.nodes.values()
+                     if n.node_id.startswith(target)), None)
+        if node is not None:
+            return await self._node_client(node).call(
+                "profile", op="run", hz=hz, duration_s=duration_s, fmt=fmt,
+                timeout=duration_s + 30.0)
+        for n in list(self.nodes.values()):
+            try:
+                reply = await self._node_client(n).call(
+                    "profile_worker", worker=target, hz=hz,
+                    duration_s=duration_s, fmt=fmt,
+                    timeout=duration_s + 35.0)
+            except Exception:
+                continue
+            if reply.get("found"):
+                reply["node_id"] = n.node_id
+                return reply
+        return {"ok": False,
+                "error": f"no process matches target {target!r} "
+                         f"(expected \"head\", a node id prefix, or a "
+                         f"worker id prefix)"}
+
+    @staticmethod
+    def _query_params(query: str) -> Dict[str, str]:
+        from urllib.parse import parse_qs
+
+        return {k: v[-1] for k, v in parse_qs(query or "").items()}
+
+    async def _http_stack(self, query: str = ""):
+        import json as _json
+
+        p = self._query_params(query)
+        out = await self.rpc_cluster_stack(target=p.get("target", ""))
+        return "application/json", _json.dumps(out, default=str).encode()
+
+    async def _http_profile(self, query: str = ""):
+        import json as _json
+
+        p = self._query_params(query)
+        fmt = p.get("format", "speedscope")
+        out = await self.rpc_profile_target(
+            target=p.get("target", "head"),
+            hz=float(p.get("hz", 0) or 0),
+            duration_s=float(p.get("duration", 2.0)),
+            fmt=fmt)
+        if out.get("ok") and fmt == "speedscope":
+            # the profile field already IS speedscope JSON: serve it
+            # directly so a browser download opens in speedscope.app
+            return "application/json", out["profile"].encode()
+        return "application/json", _json.dumps(out, default=str).encode()
 
     async def rpc_metrics_port(self):
         return {"port": self.metrics_port}

@@ -170,6 +170,18 @@ class MetricsRegistry:
         with self._lock:
             self._collectors.append(fn)
 
+    def remove_collector(self, fn) -> None:
+        """Deregister a collector.  Hosts with a shorter lifetime than
+        the process (CoreWorker across init/shutdown cycles, restarted
+        serve proxies) MUST remove their collectors — the registry is a
+        process singleton, so a leaked closure pins its whole object
+        graph and re-runs on every render forever."""
+        with self._lock:
+            try:
+                self._collectors.remove(fn)
+            except ValueError:
+                pass
+
     def ingest_foreign(self, source: str, text: str) -> None:
         """Store a pushed snapshot (e.g. from a worker) for re-export."""
         with self._lock:
@@ -301,6 +313,75 @@ def dag_metrics() -> Tuple[Histogram, Counter]:
     return _dag_metrics
 
 
+_loop_lag_gauge: Optional[Gauge] = None
+
+
+def loop_lag_gauge() -> Gauge:
+    """Process-singleton ``ray_tpu_event_loop_lag_seconds``: scheduling
+    lag of the process's event loop(s), sampled by the always-on
+    profiling.loop_lag_probe and labeled by role (head | agent | driver
+    | worker | serve_proxy).  The first gauge to read when something
+    feels wedged — a loop hogged by a long callback lags here seconds
+    before RPCs time out."""
+    global _loop_lag_gauge
+    if _loop_lag_gauge is None:
+        _loop_lag_gauge = Gauge(
+            "ray_tpu_event_loop_lag_seconds",
+            "event-loop scheduling lag measured by the liveness probe")
+    return _loop_lag_gauge
+
+
+_pump_depth_gauge: Optional[Gauge] = None
+
+
+def dispatch_pump_depth_gauge() -> Gauge:
+    """Process-singleton ``ray_tpu_dispatch_pump_depth``: tasks sitting
+    in this owner's dispatch pump (pending per-class + per-actor queues,
+    not yet pushed to a leased worker) — sampled by a collector at
+    scrape/push time.  Rising depth with idle cluster CPU is the
+    signature of owner-side dispatch being the bottleneck (ROADMAP open
+    item 3)."""
+    global _pump_depth_gauge
+    if _pump_depth_gauge is None:
+        _pump_depth_gauge = Gauge(
+            "ray_tpu_dispatch_pump_depth",
+            "owner-side tasks queued in the dispatch pump")
+    return _pump_depth_gauge
+
+
+_dag_occupancy_gauge: Optional[Gauge] = None
+
+
+def dag_channel_occupancy_gauge() -> Gauge:
+    """Process-singleton ``ray_tpu_dag_channel_occupancy``: versions in
+    flight in a compiled-DAG channel ring (writer seq minus the slowest
+    reader's cursor), labeled by channel oid prefix.  Occupancy pinned
+    at max_in_flight marks the pipeline stage readers can't keep up
+    with — the pipeline-bubble signal the MPMD work needs."""
+    global _dag_occupancy_gauge
+    if _dag_occupancy_gauge is None:
+        _dag_occupancy_gauge = Gauge(
+            "ray_tpu_dag_channel_occupancy",
+            "compiled-DAG channel ring versions in flight")
+    return _dag_occupancy_gauge
+
+
+_serve_inflight_gauge: Optional[Gauge] = None
+
+
+def serve_proxy_inflight_gauge() -> Gauge:
+    """Process-singleton ``ray_tpu_serve_proxy_inflight``: requests
+    currently admitted past the Serve proxy's shed gate (serve/http.py),
+    sampled by a collector at scrape time.  Tracks how close the proxy
+    runs to ``serve_max_inflight_requests``."""
+    global _serve_inflight_gauge
+    if _serve_inflight_gauge is None:
+        _serve_inflight_gauge = Gauge(
+            "ray_tpu_serve_proxy_inflight",
+            "serve HTTP requests currently in flight past the shed gate")
+    return _serve_inflight_gauge
+
+
 _serve_request_latency: Optional[Histogram] = None
 
 
@@ -329,7 +410,11 @@ async def start_metrics_http_server(registry: MetricsRegistry,
     ``extra_routes`` ({path: () -> (content_type, bytes)}) — the head
     mounts its dashboard page here.  A route key ENDING in "/" is a
     prefix route: its handler is called with the remaining path suffix
-    (e.g. "/api/traces/" serves /api/traces/<trace_id>).
+    (e.g. "/api/traces/" serves /api/traces/<trace_id>).  A handler
+    carrying a truthy ``wants_query`` attribute additionally receives
+    the raw query string as its last positional argument, and a handler
+    returning a coroutine is awaited on the serving loop (the head's
+    /api/stack and /api/profile fan out over RPC).
 
     Handcrafted on asyncio (no aiohttp in the image); Prometheus needs
     nothing beyond status line + content-type + body."""
@@ -356,12 +441,19 @@ async def start_metrics_http_server(registry: MetricsRegistry,
                 if line in (b"\r\n", b"\n", b""):
                     break
             parts = request.decode("latin-1").split()
-            path = (parts[1] if len(parts) >= 2 else "/").split("?")[0]
+            raw_path = parts[1] if len(parts) >= 2 else "/"
+            path, _, query = raw_path.partition("?")
             ctype = b"text/plain; version=0.0.4"
             route, suffix = _match(path)
             if route is not None:
                 try:
-                    ct, body = route() if suffix is None else route(suffix)
+                    args = [] if suffix is None else [suffix]
+                    if getattr(route, "wants_query", False):
+                        args.append(query)
+                    res = route(*args)
+                    if asyncio.iscoroutine(res):
+                        res = await res
+                    ct, body = res
                     ctype = ct.encode()
                     status = b"200 OK"
                 except Exception as e:  # route bug must not kill serving

@@ -35,7 +35,9 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 from ray_tpu._private.config import config
 from ray_tpu._private.errors import RuntimeEnvSetupError
 from ray_tpu._private.ids import NodeID, WorkerID
+from ray_tpu._private.log_monitor import LogMonitor
 from ray_tpu._private.object_store import StoreCore
+from ray_tpu._private.profiling import IntrospectionRpcMixin, loop_lag_probe
 from ray_tpu._private.object_transfer import (ObjectTransferClient,
                                               ObjectTransferServer,
                                               TransferError, dest_view)
@@ -98,7 +100,7 @@ class _Lease:
         self.donated: Optional[ResourceSet] = None  # what blocking released
 
 
-class NodeAgent(RpcHost):
+class NodeAgent(IntrospectionRpcMixin, RpcHost):
     def __init__(self, head_addr: Tuple[str, int], session_dir: str,
                  resources: Dict[str, float], arena_path: str = "",
                  capacity: int = 0, is_head_node: bool = False,
@@ -182,6 +184,11 @@ class NodeAgent(RpcHost):
         # queued bundle reservations by bundle key, so the head can
         # cancel a waited reservation whose RPC failed on its side
         self._reserve_tokens: Dict[str, Tuple[object, LocalScheduler]] = {}
+        # live introspection: worker-log tailing for subscribed drivers
+        # (log_monitor.py) + the latest loop-lag probe sample, folded
+        # into heartbeat metric summaries for the head time-series ring
+        self._log = LogMonitor(self.node_id)
+        self._last_loop_lag = 0.0
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -202,6 +209,12 @@ class NodeAgent(RpcHost):
                                  dir_version=reply.get("dir_version"))
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+
+        def _lag(sample: float) -> None:
+            self._last_loop_lag = sample
+
+        self._tasks.append(asyncio.ensure_future(
+            loop_lag_probe("agent", on_sample=_lag)))
         if config.memory_monitor_refresh_ms > 0:
             self._tasks.append(
                 asyncio.ensure_future(self._memory_monitor_loop()))
@@ -260,6 +273,7 @@ class NodeAgent(RpcHost):
         return {"objects": self.store.list_objects(limit)}
 
     async def stop(self):
+        self._log.stop()
         for t in self._tasks:
             t.cancel()
         for w in list(self._workers.values()):
@@ -323,6 +337,24 @@ class NodeAgent(RpcHost):
                                      payload.get("scalable"),
                                      payload.get("dir_version"))
 
+    def _metric_summary(self) -> Dict[str, float]:
+        """Small per-node gauge snapshot piggybacked on every heartbeat;
+        the head folds it into the bounded time-series ring behind
+        /api/timeseries and `rtpu status --watch` (reference role: the
+        reporter agent's periodic node stats push)."""
+        out = {
+            "loop_lag_seconds": round(self._last_loop_lag, 6),
+            "workers": float(len(self._workers)),
+            "leases": float(len(self._leases)),
+            "lease_queue_depth": float(len(self._lease_waiters)),
+        }
+        try:
+            u = self.store.usage()
+            out["object_store_bytes"] = float(u.get("allocated", 0))
+        except Exception:
+            pass
+        return out
+
     def _pending_for_heartbeat(self) -> List[Dict[str, float]]:
         """Queued lease demands plus parked infeasible-but-scalable
         demands (the autoscaler's input; reference: load_metrics.py)."""
@@ -343,7 +375,8 @@ class NodeAgent(RpcHost):
                     objects=self.store.object_summary(
                         int(config.locality_min_bytes),
                         int(config.object_directory_max_entries)),
-                    seen_dir_version=self._seen_dir_version)
+                    seen_dir_version=self._seen_dir_version,
+                    metrics=self._metric_summary())
                 if reply.get("unknown_node"):
                     # the head restarted without our entry (or reaped us
                     # during its downtime): re-register under the SAME
@@ -773,6 +806,10 @@ class NodeAgent(RpcHost):
             "RT_NODE_ID": self.node_id,
             "RT_WORKER_ID": worker_id,
             "RT_SESSION_DIR": self.session_dir,
+            # unbuffered stdout: a task's print() reaches the log file
+            # (and any subscribed driver) immediately, not at the next
+            # 8KB block flush
+            "PYTHONUNBUFFERED": "1",
         })
         if working_dir:
             env["RT_WORKING_DIR"] = working_dir
@@ -780,7 +817,8 @@ class NodeAgent(RpcHost):
             env["RT_PY_MODULES"] = os.pathsep.join(path_dirs)
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
-        out = open(os.path.join(log_dir, f"worker-{worker_id[:12]}.log"), "ab")
+        log_path = os.path.join(log_dir, f"worker-{worker_id[:12]}.log")
+        out = open(log_path, "ab")
         from ray_tpu._private.spawn import fast_python_cmd, set_pdeathsig
 
         cmd, env_up = fast_python_cmd("ray_tpu._private.worker_main")
@@ -789,6 +827,10 @@ class NodeAgent(RpcHost):
             cmd, env=env, stdout=out, stderr=subprocess.STDOUT,
             start_new_session=True, preexec_fn=set_pdeathsig)
         out.close()
+        # stream THIS agent's worker logs only (the session logs dir may
+        # be shared by several agents) — each line reaches a subscribed
+        # driver exactly once
+        self._log.add_file(log_path, proc.pid, worker_id)
         w = _Worker(worker_id, proc, env_key=env_key)
         self._workers[worker_id] = w
         self._starting += 1
@@ -831,6 +873,8 @@ class NodeAgent(RpcHost):
         w = self._workers.pop(worker_id, None)
         if w is None:
             return
+        # log monitor drains the file once more, then evicts it
+        self._log.mark_dead(worker_id)
         if w in self._idle:
             self._idle.remove(w)
         if not w.ready.is_set():
@@ -1469,6 +1513,81 @@ class NodeAgent(RpcHost):
                 self._unblock_pending.discard(lease_id)
                 continue
             self._try_reacquire(lease)
+
+    # ---- live introspection (profiling.py + log_monitor.py) ----------------
+
+    def on_peer_disconnect(self, conn) -> None:
+        self._log.unsubscribe(conn)
+
+    async def rpc_subscribe_logs(self, tail: int = 0, _conn=None):
+        """Stream this node's worker-log increments to the caller as
+        ``log_lines`` oneway pushes on this connection (reference:
+        _private/log_monitor.py:103 — the driver-side `(pid=, node=)`
+        log streaming).  Returns up to ``tail`` backlog lines/file."""
+        if _conn is None:
+            return {"ok": False, "error": "no connection"}
+        backlog = self._log.subscribe(_conn, tail=int(tail))
+        return {"ok": True, "node_id": self.node_id, "backlog": backlog}
+
+    async def rpc_unsubscribe_logs(self, _conn=None):
+        if _conn is not None:
+            self._log.unsubscribe(_conn)
+        return {"ok": True}
+
+    async def rpc_tail_logs(self, lines: int = 100):
+        """One-shot: last N lines of every worker log this agent owns."""
+        return {"ok": True, "node_id": self.node_id,
+                "batch": self._log.tail(int(lines))}
+
+    async def _call_worker(self, w: _Worker, method: str, timeout: float,
+                           **payload):
+        """One transient RPC to a pooled worker's server (introspection
+        only — not a task-path connection, so no pooling needed)."""
+        client = RpcClient("127.0.0.1", w.port, label=f"introspect-{w.pid}")
+        try:
+            return await client.call(method, timeout=timeout, **payload)
+        finally:
+            await client.close()
+
+    async def rpc_node_stacks(self, timeout_s: float = 5.0):
+        """Aggregate live stack dumps: this agent process plus every
+        ready worker it pools (the `rtpu stack <node>` payload)."""
+        from ray_tpu._private.profiling import proc_stack_payload
+
+        result: Dict[str, Any] = {"node_id": self.node_id,
+                                  "agent": proc_stack_payload(),
+                                  "workers": {}}
+
+        async def one(w: _Worker):
+            try:
+                result["workers"][w.worker_id] = await asyncio.wait_for(
+                    self._call_worker(w, "proc_stack", timeout_s),
+                    timeout_s + 1.0)
+            except Exception as e:
+                result["workers"][w.worker_id] = {
+                    "pid": w.pid, "error": f"{type(e).__name__}: {e}"}
+
+        await asyncio.gather(*(one(w) for w in list(self._workers.values())
+                               if w.ready.is_set() and w.port
+                               and w.proc.poll() is None))
+        return result
+
+    async def rpc_profile_worker(self, worker: str, hz: float = 0,
+                                 duration_s: float = 2.0,
+                                 fmt: str = "collapsed"):
+        """Proxy a sampling-profiler run to one of this node's workers
+        (matched by worker-id prefix).  Blocks for the duration."""
+        target = next((w for wid, w in self._workers.items()
+                       if wid.startswith(worker) and w.ready.is_set()
+                       and w.port and w.proc.poll() is None), None)
+        if target is None:
+            return {"found": False}
+        reply = await self._call_worker(
+            target, "profile", float(duration_s) + 30.0, op="run", hz=hz,
+            duration_s=duration_s, fmt=fmt)
+        reply["found"] = True
+        reply["worker_id"] = target.worker_id
+        return reply
 
     # ---- misc --------------------------------------------------------------
 

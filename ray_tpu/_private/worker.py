@@ -53,6 +53,7 @@ from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.memory_store import MemoryStore
 from ray_tpu._private.object_ref import ObjectRef, SerializationContext
 from ray_tpu._private.object_store import PlasmaClient
+from ray_tpu._private.profiling import IntrospectionRpcMixin, loop_lag_probe
 from ray_tpu._private.reference_count import ReferenceCounter
 from ray_tpu._private.streaming import (STREAMING, ObjectRefGenerator,
                                         StreamState)
@@ -312,10 +313,11 @@ class _ActorState:
         self.pump_queued = False  # coalesced-pump callback scheduled
 
 
-class CoreWorker(RpcHost):
+class CoreWorker(IntrospectionRpcMixin, RpcHost):
     def __init__(self, mode: str, head_addr: Tuple[str, int],
                  agent_addr: Tuple[str, int], arena_path: str,
-                 node_id: str, worker_id: str = "", job_id: str = ""):
+                 node_id: str, worker_id: str = "", job_id: str = "",
+                 log_to_driver: Optional[bool] = None):
         self.mode = mode
         self.node_id = node_id
         self.worker_id = worker_id or WorkerID.from_random().hex()
@@ -388,7 +390,23 @@ class CoreWorker(RpcHost):
         self._task_events: List[Dict[str, Any]] = []
         self._task_events_lock = threading.Lock()
         self._flush_soon = False  # completion-flush scheduled (under lock)
+        self._metrics_collector = None  # set by _observability_loop
         self._io.spawn(self._observability_loop())
+        # live introspection: loop-lag health probe on the IO loop, and
+        # (drivers) worker-log streaming — every agent's log monitor
+        # pushes its workers' stdout/stderr lines here, printed with
+        # (pid=..., node=...) prefixes (reference: log_to_driver)
+        self._io.spawn(loop_lag_probe(
+            "driver" if mode == MODE_DRIVER else "worker"))
+        if log_to_driver is None:
+            log_to_driver = bool(config.log_to_driver)
+        # agent addrs with an active log subscription: _aclient_agent
+        # re-subscribes on any replacement connection (subscriptions are
+        # per-connection server-side, so a silent drop would otherwise
+        # end streaming for the driver's whole lifetime)
+        self._log_subscribed: Set[Tuple[str, int]] = set()
+        if mode == MODE_DRIVER and log_to_driver:
+            self._io.spawn(self._subscribe_worker_logs())
         # streaming generator tasks we own: task_id -> StreamState
         # (reference: _raylet.pyx ObjectRefGenerator machinery)
         self._streams: Dict[str, StreamState] = {}
@@ -512,10 +530,24 @@ class CoreWorker(RpcHost):
     async def _observability_loop(self):
         import asyncio
 
-        from ray_tpu._private.metrics import default_registry
+        from ray_tpu._private.metrics import (default_registry,
+                                              dispatch_pump_depth_gauge)
 
         default_registry.default_tags.setdefault(
             "worker_id", self.worker_id[:12])
+        pump_depth = dispatch_pump_depth_gauge()
+
+        def collect():
+            # owner-side queued work not yet pushed to a lease: the
+            # "is dispatch the bottleneck" gauge (sampled at render,
+            # zero hot-path cost; dict snapshots tolerate cross-thread
+            # mutation)
+            depth = sum(len(s.pending) for s in list(self._sched.values()))
+            depth += sum(len(a.pending) for a in list(self._actors.values()))
+            pump_depth.set(depth)
+
+        self._metrics_collector = collect  # removed again in shutdown()
+        default_registry.add_collector(collect)
         interval = max(0.2, config.metrics_report_interval_ms / 1000.0 / 5)
         while not self._shutdown:
             await asyncio.sleep(interval)
@@ -657,13 +689,62 @@ class CoreWorker(RpcHost):
         addr = (addr[0], addr[1])
         c = self._agent_clients.get(addr)
         if c is None or c.dead:
+            resubscribe = c is not None and addr in self._log_subscribed
             c = RpcClient(addr[0], addr[1], label=f"agent-{addr[1]}",
                           on_push=self._on_agent_push)
             self._agent_clients[addr] = c
+            if resubscribe:
+                # the old connection carried our log subscription (per-
+                # connection server-side): renew it on the replacement
+                # so streaming survives agent reconnects
+                async def _resub(client=c):
+                    try:
+                        await client.call("subscribe_logs", tail=0)
+                    except Exception:
+                        pass
+
+                self._spawn(_resub())
         return c
+
+    async def _subscribe_worker_logs(self):
+        """Driver mode: subscribe to every node agent's log monitor so
+        worker stdout/stderr streams to this driver's console
+        (reference: _private/log_monitor.py + worker.py print_logs).
+        Agents joining later are not auto-subscribed — `rtpu logs
+        --follow` covers operator use on growing clusters."""
+        try:
+            table = await self.head.aio.call("node_table")
+        except Exception:
+            table = {self.node_id: {"addr": list(self.agent_addr)}}
+        for entry in table.values():
+            addr = entry.get("addr")
+            if not addr:
+                continue
+            try:
+                client = await self._aclient_agent((addr[0], addr[1]))
+                await client.call("subscribe_logs", tail=0)
+                self._log_subscribed.add((addr[0], addr[1]))
+            except Exception:
+                pass  # an unreachable agent must not fail driver init
+
+    def _print_log_lines(self, payload: Dict[str, Any]) -> None:
+        """Render a log_lines push: one prefixed line per worker line,
+        mirroring the reference's `(pid=..., ip=...)` driver output."""
+        import sys
+
+        node = (payload.get("node_id") or "")[:12]
+        out = []
+        for ent in payload.get("batch") or []:
+            prefix = f"(pid={ent.get('pid')}, node={node}) "
+            out.extend(prefix + line for line in ent.get("lines") or [])
+        if out:
+            print("\n".join(out), file=sys.stdout, flush=True)
 
     def _on_agent_push(self, method: str, payload: Dict[str, Any]):
         """Oneway pushes from a node agent (runs on the IO loop)."""
+        if method == "log_lines":
+            self._print_log_lines(payload)
+            return
         if method == "reclaim_idle_leases":
             # demand queued behind our leases on THAT agent: hand back
             # warm-pool leases NOW instead of after the TTL sweep.  The
@@ -708,6 +789,14 @@ class CoreWorker(RpcHost):
                     self._spawn(self._return_lease(state, lease))
 
     def shutdown(self):
+        # deregister our pump-depth collector from the process-singleton
+        # registry: a leaked closure would pin this whole worker graph
+        # across init/shutdown cycles (and keep sampling dead state)
+        if self._metrics_collector is not None:
+            from ray_tpu._private.metrics import default_registry
+
+            default_registry.remove_collector(self._metrics_collector)
+            self._metrics_collector = None
         # flush buffered task events before tearing the IO plane down —
         # a short-lived driver's SUBMITTED events live in the last
         # interval of the observability loop

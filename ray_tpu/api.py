@@ -39,6 +39,7 @@ def init(address: Optional[str] = None, *,
          resources: Optional[Dict[str, float]] = None,
          object_store_memory: Optional[int] = None,
          runtime_env: Optional[Dict[str, Any]] = None,
+         log_to_driver: Optional[bool] = None,
          _system_config: Optional[Dict[str, Any]] = None,
          ignore_reinit_error: bool = False):
     """Start (or connect to) a cluster and attach this process as a driver.
@@ -46,6 +47,10 @@ def init(address: Optional[str] = None, *,
     With no address, spawns a head service and one node agent locally
     (reference: worker.py:1217 bootstrap path). With address="host:port",
     connects to an existing head and uses the head node's agent.
+
+    ``log_to_driver`` (default: config ``log_to_driver``, on) streams
+    worker stdout/stderr to this driver's console with
+    ``(pid=..., node=...)`` prefixes via the node agents' log monitors.
     """
     import os as _os
 
@@ -111,7 +116,7 @@ def init(address: Optional[str] = None, *,
             _global_node = None
         worker = CoreWorker(MODE_DRIVER, head_addr, info["addr"],
                             None if client_mode else info["arena_path"],
-                            info["node_id"])
+                            info["node_id"], log_to_driver=log_to_driver)
         if runtime_env:
             # job-level default: every task/actor of this driver inherits
             # it unless overridden (reference: job_config.runtime_env)

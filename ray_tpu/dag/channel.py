@@ -416,7 +416,8 @@ class ChannelWriter:
         With error=True, `value` is an exception to serialize into the
         version (readers surface it instead of a value)."""
         from ray_tpu._private import serialization
-        from ray_tpu._private.metrics import dag_metrics
+        from ray_tpu._private.metrics import (dag_channel_occupancy_gauge,
+                                              dag_metrics)
 
         if error:
             frames = [memoryview(pickle_error(value))]
@@ -449,6 +450,13 @@ class ChannelWriter:
         for t in self._targets:
             t.push_version(view, base, _VHDR + total)
         dag_metrics()[1].inc(tags={"op": "write"})
+        # ring occupancy = published versions the slowest reader hasn't
+        # consumed (cached cursors; no remote refresh on the hot path).
+        # Pinned at max_in_flight == this stage's readers are the
+        # pipeline bottleneck.
+        dag_channel_occupancy_gauge().set(
+            seq - self._min_cursor(refresh_remote=False),
+            tags={"channel": self.spec.oid[:12]})
         return seq
 
     def close(self, propagate: bool = True) -> None:

@@ -494,8 +494,6 @@ class Dataset:
 
     def _submit_block(self, ref) -> Any:
         """Launch the fused op chain on one source block; returns a ref."""
-        import ray_tpu
-
         if not self._ops:
             return ref
         fn = _remote_fused()

@@ -8,7 +8,7 @@ python/ray/util/state/state_cli.py).  Installed as `rtpu` via
 
   rtpu start --head [--port N] [--num-cpus N] [--resources JSON]
   rtpu start --address HOST:PORT [--num-cpus N]     # join as a worker node
-  rtpu status [--address HOST:PORT]
+  rtpu status [--watch] [--address HOST:PORT]
   rtpu stop   [--address HOST:PORT]
   rtpu job submit [--address A] [--working-dir D] -- python train.py
   rtpu job status|logs|stop JOB_ID
@@ -17,6 +17,9 @@ python/ray/util/state/state_cli.py).  Installed as `rtpu` via
   rtpu timeline -o trace.json
   rtpu trace list [--limit N]
   rtpu trace get TRACE_ID [-o trace.json]
+  rtpu stack [TARGET]               # live tracebacks: head/agents/workers
+  rtpu profile TARGET --duration N  # sampling profiler (collapsed/speedscope)
+  rtpu logs [--follow] [--tail N]   # worker logs streamed off the agents
 
 Cluster discovery: `start --head` records the address in
 $RT_TMPDIR/latest_cluster.json; other commands use --address,
@@ -120,16 +123,10 @@ def cmd_stop(args) -> int:
     return 0
 
 
-def cmd_status(args) -> int:
-    addr = _resolve_address(args.address)
-    head, io = _head_client(addr)
-    try:
-        table = head.call("node_table", timeout=10)
-        res = head.call("cluster_resources", timeout=10)
-        auto = head.call("autoscaler_state", timeout=10)
-    finally:
-        head.close()
-        io.stop()
+def _print_status(addr, head) -> None:
+    table = head.call("node_table", timeout=10)
+    res = head.call("cluster_resources", timeout=10)
+    auto = head.call("autoscaler_state", timeout=10)
     print(f"cluster at {addr[0]}:{addr[1]} — {len(table)} node(s)")
     for nid, n in table.items():
         r = n["resources"]
@@ -142,7 +139,188 @@ def cmd_status(args) -> int:
         print(f"pending demands: {pending} lease(s), "
               f"{len(auto['pending_pg_bundles'])} pg bundle(s), "
               f"{len(auto['pending_actors'])} actor(s)")
+
+
+def _print_timeseries(head) -> None:
+    """Latest value (+ tiny text sparkline) per head time-series —
+    the `status --watch` health pane."""
+    blocks = " ▁▂▃▄▅▆▇█"
+    series = head.call("timeseries", timeout=10).get("series") or []
+    if not series:
+        return
+    print("gauges (head time-series ring):")
+    for s in series:
+        pts = [v for _, v in s.get("points") or []]
+        if not pts:
+            continue
+        lo, hi = min(pts), max(pts)
+        span = (hi - lo) or 1.0
+        spark = "".join(
+            blocks[int((v - lo) / span * (len(blocks) - 1))]
+            for v in pts[-30:])
+        print(f"  {s['name']:<24} @{s['node']:<12} "
+              f"{pts[-1]:>12.6g}  {spark}")
+
+
+def cmd_status(args) -> int:
+    addr = _resolve_address(args.address)
+    head, io = _head_client(addr)
+    try:
+        while True:
+            if args.watch:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear, home
+            _print_status(addr, head)
+            _print_timeseries(head)
+            if not args.watch:
+                return 0
+            sys.stdout.flush()
+            try:
+                time.sleep(args.interval)
+            except KeyboardInterrupt:
+                return 0
+    finally:
+        head.close()
+        io.stop()
+
+
+# ------------------------------------------------------- live introspection
+
+
+def cmd_stack(args) -> int:
+    """Live stack dumps for every process in the cluster (or one node /
+    worker / the head via TARGET) — `ray stack` equivalent, no py-spy."""
+    addr = _resolve_address(args.address)
+    head, io = _head_client(addr)
+    try:
+        out = head.call("cluster_stack", target=args.target or "",
+                        timeout=30)
+    finally:
+        head.close()
+        io.stop()
+    shown = 0
+
+    def _one(title: str, payload) -> None:
+        nonlocal shown
+        if not isinstance(payload, dict):
+            return
+        if payload.get("error"):
+            print(f"==== {title}: unreachable ({payload['error']}) ====")
+            return
+        print(f"==== {title} (pid {payload.get('pid')}) ====")
+        print(payload.get("text", ""))
+        shown += 1
+
+    if "head" in out:
+        _one("head", out["head"])
+    want_worker = args.target or ""
+    for nid, node in (out.get("nodes") or {}).items():
+        if not isinstance(node, dict) or node.get("error"):
+            print(f"==== node {nid[:12]}: unreachable "
+                  f"({node.get('error') if isinstance(node, dict) else node})"
+                  f" ====")
+            continue
+        workers = node.get("workers") or {}
+        worker_only = (want_worker
+                       and not nid.startswith(want_worker)
+                       and want_worker != "head")
+        if not worker_only:
+            _one(f"node {nid[:12]} agent", node.get("agent") or {})
+        for wid, w in workers.items():
+            if worker_only and not wid.startswith(want_worker):
+                continue
+            _one(f"node {nid[:12]} worker {wid[:12]}", w)
+    if shown == 0:
+        print(f"no process matched target {args.target!r}", file=sys.stderr)
+        return 1
     return 0
+
+
+def cmd_profile(args) -> int:
+    """Run the in-process sampling profiler on a target process and
+    print (or save) the collapsed stacks / speedscope JSON."""
+    addr = _resolve_address(args.address)
+    head, io = _head_client(addr)
+    try:
+        reply = head.call("profile_target", target=args.target,
+                          hz=args.hz, duration_s=args.duration,
+                          fmt=args.format,
+                          timeout=args.duration + 60)
+    finally:
+        head.close()
+        io.stop()
+    if not reply.get("ok"):
+        print(f"profile failed: {reply.get('error', reply)}",
+              file=sys.stderr)
+        return 1
+    print(f"profiled pid {reply.get('pid')} at {reply.get('hz')}Hz for "
+          f"{reply.get('duration_s')}s ({reply.get('samples')} samples)",
+          file=sys.stderr)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(reply["profile"])
+        print(f"wrote {args.format} profile to {args.output}",
+              file=sys.stderr)
+    else:
+        sys.stdout.write(reply["profile"])
+        if not reply["profile"].endswith("\n"):
+            sys.stdout.write("\n")
+    return 0
+
+
+def _print_log_batch(node_id: str, batch) -> None:
+    for ent in batch or []:
+        prefix = f"(pid={ent.get('pid')}, node={node_id[:12]}) "
+        for line in ent.get("lines") or []:
+            print(prefix + line)
+
+
+def cmd_logs(args) -> int:
+    """Tail worker logs across the cluster; with --follow, subscribe to
+    every node agent's log monitor and stream increments live."""
+    from ray_tpu._private.rpc import EventLoopThread, SyncRpcClient
+
+    addr = _resolve_address(args.address)
+    head, io = _head_client(addr)
+    agents = []
+    try:
+        table = head.call("node_table", timeout=10)
+        head.close()
+        for nid, entry in table.items():
+            ahost, aport = entry["addr"]
+
+            def on_push(method, payload, _nid=nid):
+                if method == "log_lines":
+                    _print_log_batch(payload.get("node_id", _nid),
+                                     payload.get("batch"))
+
+            client = SyncRpcClient(ahost, aport, io,
+                                   label=f"agent-{nid[:8]}",
+                                   on_push=on_push if args.follow else None)
+            agents.append((nid, client))
+        if not agents:
+            print("no nodes registered", file=sys.stderr)
+            return 1
+        if not args.follow:
+            for nid, client in agents:
+                reply = client.call("tail_logs", lines=args.tail, timeout=10)
+                _print_log_batch(reply.get("node_id", nid),
+                                 reply.get("batch"))
+            return 0
+        for nid, client in agents:
+            reply = client.call("subscribe_logs", tail=args.tail, timeout=10)
+            _print_log_batch(reply.get("node_id", nid),
+                             reply.get("backlog"))
+        print("-- following (Ctrl-C to stop) --", file=sys.stderr)
+        try:
+            while True:
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            return 0
+    finally:
+        head.close()
+        for _, client in agents:
+            client.close()
+        io.stop()
 
 
 # ---------------------------------------------------------------------- jobs
@@ -296,7 +474,37 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("status", help="nodes, resources, pending demand")
     p.add_argument("--address", default="")
+    p.add_argument("--watch", action="store_true",
+                   help="refresh continuously with the head's gauge series")
+    p.add_argument("--interval", type=float, default=2.0)
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("stack",
+                       help="live stack dumps of cluster processes")
+    p.add_argument("target", nargs="?", default="",
+                   help='"head", a node id prefix, or a worker id prefix')
+    p.add_argument("--address", default="")
+    p.set_defaults(fn=cmd_stack)
+
+    p = sub.add_parser("profile", help="sampling-profile one process")
+    p.add_argument("target",
+                   help='"head", a node id prefix, or a worker id prefix')
+    p.add_argument("--duration", type=float, default=5.0)
+    p.add_argument("--hz", type=float, default=0,
+                   help="sampling rate (default: profiler_default_hz)")
+    p.add_argument("--format", choices=["collapsed", "speedscope"],
+                   default="collapsed")
+    p.add_argument("-o", "--output", default="")
+    p.add_argument("--address", default="")
+    p.set_defaults(fn=cmd_profile)
+
+    p = sub.add_parser("logs", help="tail worker logs across the cluster")
+    p.add_argument("--follow", "-f", action="store_true",
+                   help="stream new lines as they appear")
+    p.add_argument("--tail", type=int, default=100,
+                   help="backlog lines per file")
+    p.add_argument("--address", default="")
+    p.set_defaults(fn=cmd_logs)
 
     p = sub.add_parser("job", help="submit and manage jobs")
     p.add_argument("--address", default="")

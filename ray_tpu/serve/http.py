@@ -90,7 +90,9 @@ class _HttpProxy:
         import asyncio
 
         from ray_tpu._private.config import config
-        from ray_tpu._private.metrics import serve_request_latency_histogram
+        from ray_tpu._private.metrics import (default_registry,
+                                              serve_proxy_inflight_gauge,
+                                              serve_request_latency_histogram)
 
         self._handles: Dict[str, Any] = {}
         self._legacy = legacy_threads
@@ -99,6 +101,14 @@ class _HttpProxy:
             else config.serve_max_inflight_requests)
         self._inflight = 0  # loop-confined: touched only on the proxy loop
         self._latency = serve_request_latency_histogram()
+        # inflight gauge sampled at metrics render — zero cost on the
+        # request hot path (see metrics.serve_proxy_inflight_gauge).
+        # The collector is deregistered when the serve loop exits so a
+        # recycled worker process hosting successive proxies doesn't
+        # accumulate closures over dead instances.
+        inflight_g = serve_proxy_inflight_gauge()
+        self._inflight_collector = lambda: inflight_g.set(self._inflight)
+        default_registry.add_collector(self._inflight_collector)
         self._loop = asyncio.new_event_loop()
         self._loop_thread_ident = None  # set by the serve thread
         self._started = threading.Event()
@@ -121,10 +131,18 @@ class _HttpProxy:
         # path (not a raw ValueError from readline) handles long lines
         limit = max(2 ** 16, 2 * int(config.serve_max_header_bytes))
 
+        probe_task = None
+
         async def _start():
+            nonlocal probe_task
+            from ray_tpu._private.profiling import loop_lag_probe
+
             server = await asyncio.start_server(self._client, host, port,
                                                 limit=limit)
             self._addr = server.sockets[0].getsockname()[:2]
+            # health probe for the proxy's own loop: request handling is
+            # loop-confined, so lag here IS added request latency
+            probe_task = asyncio.ensure_future(loop_lag_probe("serve_proxy"))
             self._started.set()
             return server
 
@@ -132,6 +150,13 @@ class _HttpProxy:
         try:
             self._loop.run_forever()
         finally:
+            # a forever-task left pending when the loop dies spews
+            # "Task was destroyed but it is pending!" at teardown
+            if probe_task is not None:
+                probe_task.cancel()
+            from ray_tpu._private.metrics import default_registry
+
+            default_registry.remove_collector(self._inflight_collector)
             server.close()
 
     def address(self):

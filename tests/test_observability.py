@@ -156,9 +156,12 @@ def test_metrics_exposition_format_valid(cluster):
     while time.monotonic() < deadline:
         head_text, agent_text = _scrape(head_port), _scrape(agent_port)
         # wait until the interesting families are present so the
-        # validation actually covers them (worker push + head ingest)
+        # validation actually covers them (worker push + head ingest +
+        # the introspection loop-lag probes on both daemons)
         if "ray_tpu_task_sched_latency_seconds_bucket" in head_text \
-                and "rt_tasks_finished" in agent_text:
+                and "rt_tasks_finished" in agent_text \
+                and "ray_tpu_event_loop_lag_seconds" in head_text \
+                and "ray_tpu_event_loop_lag_seconds" in agent_text:
             break
         time.sleep(0.5)
     _assert_valid_exposition(head_text)
@@ -168,6 +171,18 @@ def test_metrics_exposition_format_valid(cluster):
     for phase in ("queued", "leased", "running"):
         assert f'phase="{phase}"' in head_text, phase
     assert "rt_head_traces" in head_text
+    # always-on introspection gauges: the loop-lag probe on each daemon
+    # and the owner-side dispatch-pump depth riding the worker push
+    assert 'ray_tpu_event_loop_lag_seconds{role="head"}' in head_text
+    assert 'role="agent"' in agent_text
+    deadline = time.monotonic() + 45
+    while time.monotonic() < deadline:
+        if "ray_tpu_dispatch_pump_depth" in agent_text:
+            break
+        time.sleep(0.5)
+        agent_text = _scrape(agent_port)
+    assert "ray_tpu_dispatch_pump_depth" in agent_text
+    _assert_valid_exposition(agent_text)
     # tracing self-metrics ride the worker push to the agent endpoint
     deadline = time.monotonic() + 45
     while time.monotonic() < deadline:
@@ -282,6 +297,51 @@ def test_list_objects(cluster):
     assert any(o["object_id"] == ref.oid for o in objs), objs
     assert all("size" in o and "node_id" in o for o in objs)
     del ref
+
+
+def test_metric_names_documented_in_readme(cluster):
+    """Every framework metric family registered at runtime must appear
+    in README.md's Observability metrics table — undocumented metrics
+    fail CI (VERDICT/ISSUE 6 satellite).  Covers both what the live
+    endpoints expose and every process-singleton family the codebase
+    can register lazily (dag/serve/xfer/introspection helpers)."""
+    import os
+
+    @ray_tpu.remote
+    def f(x):
+        return x
+
+    ray_tpu.get([f.remote(i) for i in range(10)], timeout=60)
+    head_port, agent_port = _head_metrics_port(), _agent_metrics_port()
+    deadline = time.monotonic() + 30
+    names = set()
+    while time.monotonic() < deadline:
+        text = _scrape(head_port) + _scrape(agent_port)
+        names = {ln.split()[2] for ln in text.splitlines()
+                 if ln.startswith("# TYPE ")}
+        if "rt_tasks_finished" in names:
+            break
+        time.sleep(0.5)
+    # force-register every lazy singleton family so the diff also
+    # covers code paths this test didn't exercise (dag, serve, xfer)
+    from ray_tpu._private import metrics as m
+
+    for fn in (m.object_transfer_metrics, m.dag_metrics,
+               m.serve_request_latency_histogram, m.loop_lag_gauge,
+               m.dispatch_pump_depth_gauge, m.dag_channel_occupancy_gauge,
+               m.serve_proxy_inflight_gauge):
+        fn()
+    with m.default_registry._lock:
+        names |= set(m.default_registry._metrics)
+    framework = sorted(n for n in names
+                       if n.startswith(("rt_", "ray_tpu_")))
+    assert framework, "no framework metrics scraped at all?"
+    readme = open(os.path.join(os.path.dirname(__file__), "..",
+                               "README.md")).read()
+    undocumented = [n for n in framework if n not in readme]
+    assert not undocumented, (
+        f"metrics registered at runtime but missing from the README "
+        f"metrics table: {undocumented}")
 
 
 def test_head_dashboard_spa(local_cluster):
