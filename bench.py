@@ -622,6 +622,175 @@ def bench_locality_subprocess():
     raise RuntimeError(
         f"locality bench rc={proc.returncode}: {proc.stderr[-400:]}")
 
+def _chaos_bench(total_s=9.0, kill_at_s=2.5, conns=8):
+    """Runs as a subprocess: 2 worker agents + a head node, steady Serve
+    HTTP load, one agent SIGKILLed mid-run.  Reports availability (non-
+    503/non-error success over the WHOLE run), post-kill p99 latency
+    (the recovery tail), and how long the controller took to re-heal the
+    replica set."""
+    import asyncio
+    import threading
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 4})
+    workers = [cluster.add_node(num_cpus=0, resources={"chaos": 2})
+               for _ in range(2)]
+    ray_tpu.init(address=cluster.address)
+    try:
+        cluster.wait_for_nodes(3)
+
+        # replicas can only land on the two chaos nodes (the head node
+        # has no "chaos" resource); SPREAD puts one on each
+        @serve.deployment(name="chaos_echo", num_replicas=2,
+                          max_ongoing_requests=32,
+                          ray_actor_options={
+                              "num_cpus": 0, "resources": {"chaos": 1},
+                              "scheduling_strategy": "SPREAD"})
+        def chaos_echo(x):
+            return {"ok": 1}
+
+        serve.run(chaos_echo.bind())
+        host, port = serve.start_http()
+        _serve_http_get(host, port, 4, 40, "/chaos_echo?x=1")  # warm
+
+        # which agent hosts a replica? (kill one that actually does)
+        actors = ray_tpu.api._worker().head.call("list_actors",
+                                                 timeout=30)["actors"]
+        replica_nodes = {a["node_id"] for a in actors
+                         if a.get("name", "").startswith("serve:chaos_echo")}
+        victim = next(w for w in workers if w.node_id in replica_nodes)
+
+        results = []  # (t_start_rel, ok, latency_s)
+        t0 = time.perf_counter()
+        kill_done = [0.0]
+        reheal_done = [0.0]
+
+        def alive_replicas():
+            actors = ray_tpu.api._worker().head.call("list_actors",
+                                                     timeout=10)["actors"]
+            return sum(1 for a in actors
+                       if a.get("name", "").startswith("serve:chaos_echo")
+                       and a["state"] == "ALIVE")
+
+        def killer():
+            time.sleep(kill_at_s)
+            cluster.remove_node(victim)  # SIGKILL; workers die via PDEATHSIG
+            kill_done[0] = time.perf_counter() - t0
+            # re-heal is measured from ACTOR state at the head (the dead
+            # replica goes DEAD the moment the node dies, the replacement
+            # goes ALIVE when its constructor passes) — NOT from the
+            # controller's replica-handle list, which swaps the dead
+            # handle for the replacement in one reconcile round and so
+            # never observably drops below 2
+            dropped = False
+            while time.perf_counter() - t0 < total_s + 20:
+                try:
+                    n = alive_replicas()
+                    if not dropped and n < 2:
+                        dropped = True
+                    elif dropped and n >= 2:
+                        reheal_done[0] = time.perf_counter() - t0
+                        return
+                except Exception:
+                    pass
+                time.sleep(0.1)
+
+        async def client():
+            req = (b"GET /chaos_echo?x=1 HTTP/1.1\r\nHost: bench\r\n\r\n")
+            # reconnect-and-keep-counting: a severed connection records a
+            # failure and the client RESUMES, so availability really is
+            # measured over the whole run (a client that stopped at the
+            # first break would freeze the denominator at kill time)
+            while time.perf_counter() - t0 < total_s:
+                try:
+                    reader, writer = await asyncio.open_connection(host,
+                                                                   port)
+                except OSError:
+                    results.append((time.perf_counter() - t0, False, 0.0))
+                    await asyncio.sleep(0.05)
+                    continue
+                try:
+                    while time.perf_counter() - t0 < total_s:
+                        ts = time.perf_counter()
+                        writer.write(req)
+                        await writer.drain()
+                        status = await reader.readline()
+                        if not status:
+                            # clean EOF: ONE failure for the break, then
+                            # reconnect (writing to the dead socket would
+                            # double-count it via the OSError path)
+                            results.append((ts - t0, False, 0.0))
+                            break
+                        clen = 0
+                        while True:
+                            h = await reader.readline()
+                            if h in (b"\r\n", b"\n", b""):
+                                break
+                            if h.lower().startswith(b"content-length:"):
+                                clen = int(h.split(b":", 1)[1])
+                        if clen:
+                            await reader.readexactly(clen)
+                        dt = time.perf_counter() - ts
+                        results.append((ts - t0, b"200" in status, dt))
+                except (OSError, asyncio.IncompleteReadError):
+                    results.append((time.perf_counter() - t0, False, 0.0))
+                finally:
+                    try:
+                        writer.close()
+                    except Exception:
+                        pass
+
+        async def drive():
+            await asyncio.wait_for(
+                asyncio.gather(*[client() for _ in range(conns)],
+                               return_exceptions=True),
+                timeout=total_s + 60)
+
+        kt = threading.Thread(target=killer, daemon=True)
+        kt.start()
+        asyncio.run(drive())
+        kt.join(timeout=30)
+        total = len(results)
+        ok = sum(1 for _, good, _ in results if good)
+        post_kill = sorted(dt for ts, good, dt in results
+                           if good and ts >= kill_done[0] > 0)
+        p99 = post_kill[min(len(post_kill) - 1,
+                            int(0.99 * len(post_kill)))] if post_kill else 0.0
+        out = {
+            "chaos_requests_total": total,
+            "chaos_availability_pct": round(100.0 * ok / max(total, 1), 2),
+            "chaos_p99_recovery_s": round(p99, 4),
+            "chaos_reheal_s": round(
+                max(0.0, reheal_done[0] - kill_done[0]), 2)
+            if reheal_done[0] else -1.0,
+        }
+        print("CHAOSJSON " + json.dumps(out))
+    finally:
+        try:
+            serve.shutdown_http()
+        except Exception:
+            pass
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
+def bench_chaos_subprocess():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--chaos-bench"],
+        capture_output=True, text=True, timeout=300, cwd=REPO)
+    for line in proc.stdout.splitlines():
+        if line.startswith("CHAOSJSON "):
+            return json.loads(line[len("CHAOSJSON "):])
+    raise RuntimeError(
+        f"chaos bench rc={proc.returncode}: {proc.stderr[-400:]}")
+
+
 def _train_bench_loop(force_cpu=False):
     """Runs in a watchdogged subprocess; prints one JSON line."""
     import dataclasses
@@ -767,6 +936,10 @@ def main():
     # cluster — neither shares state with the main cluster above
     phase("xfer", lambda: extras.update(bench_xfer()))
     phase("locality", lambda: extras.update(bench_locality_subprocess()))
+    # chaos_recovery: SIGKILL one of two agents under steady Serve load;
+    # contract: chaos_availability_pct >= 99 (handle-level dead-replica
+    # retry keeps clients whole while the controller re-heals)
+    phase("chaos_recovery", lambda: extras.update(bench_chaos_subprocess()))
 
     # train runs AFTER shutdown so the chip is free for the subprocess
     _run_train_subprocess(extras, errors)
@@ -787,6 +960,9 @@ if __name__ == "__main__":
     elif "--locality-bench" in sys.argv:
         sys.path.insert(0, REPO)
         _locality_bench()
+    elif "--chaos-bench" in sys.argv:
+        sys.path.insert(0, REPO)
+        _chaos_bench()
     elif "--client-bench" in sys.argv:
         sys.path.insert(0, REPO)
         i = sys.argv.index("--client-bench")

@@ -7,10 +7,11 @@ Core surface (reference: python/ray/__init__.py):
 """
 
 from ray_tpu._private.errors import (ActorDiedError, ActorUnavailableError,
-                                     GetTimeoutError, ObjectFreedError,
-                                     ObjectLostError, RayError, RayTaskError,
-                                     RayWorkerError, RuntimeEnvSetupError,
-                                     SchedulingError, TaskCancelledError)
+                                     DeploymentFailedError, GetTimeoutError,
+                                     ObjectFreedError, ObjectLostError,
+                                     RayError, RayTaskError, RayWorkerError,
+                                     RuntimeEnvSetupError, SchedulingError,
+                                     TaskCancelledError)
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.streaming import ObjectRefGenerator
 from ray_tpu.api import (ActorClass, ActorHandle, RemoteFunction,
@@ -29,6 +30,6 @@ __all__ = [
     "RayError", "RayTaskError", "RayWorkerError", "ActorDiedError",
     "ActorUnavailableError", "ObjectLostError", "ObjectFreedError",
     "GetTimeoutError", "SchedulingError", "RuntimeEnvSetupError",
-    "TaskCancelledError",
+    "TaskCancelledError", "DeploymentFailedError",
     "__version__",
 ]

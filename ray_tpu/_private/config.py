@@ -96,6 +96,22 @@ _def("dag_monitor_interval_s", 0.2)    # driver loop-ref death-watch cadence;
 # bounds how long in-flight CompiledDAGRef.get() calls can hang past an
 # actor death before they raise
 _def("dag_teardown_timeout_s", 10.0)
+# --- chaos fault injection (see _private/fault_injection.py) -----------------
+_def("chaos_enabled", True)   # the plane is inert until rules are installed
+_def("chaos_seed", 0)         # default seed for rules created without one
+# --- fault tolerance ---------------------------------------------------------
+# stateful actor restarts (__rt_save__/__rt_restore__ hooks, worker.py):
+# snapshot storage root ("" = <session_dir>/actor_state), save cadence in
+# completed method calls, and snapshots retained per actor
+_def("actor_state_storage_path", "")
+_def("actor_state_save_every_n", 1)
+_def("actor_state_keep", 2)
+# serve: replica health-check budget at deploy time (was a hardcoded
+# 600 — one wedged replica constructor stalled deploys for 10 minutes),
+# and how many surviving replicas a handle call retries against when the
+# one it picked died mid-flight
+_def("serve_replica_health_timeout_s", 120.0)
+_def("serve_dead_replica_retries", 3)
 # --- distributed tracing (see _private/tracing.py) ---------------------------
 _def("tracing_enabled", True)
 _def("trace_sampling_ratio", 1.0)      # root-span sampling probability

@@ -69,3 +69,9 @@ class RuntimeEnvSetupError(RayError):
 class TaskCancelledError(RayError):
     """The task was cancelled via ray_tpu.cancel()
     (reference: python/ray/exceptions.py TaskCancelledError)."""
+
+
+class DeploymentFailedError(RayError):
+    """A serve deployment could not come healthy: replica constructors
+    failed or did not pass the health check within
+    ``serve_replica_health_timeout_s``."""

@@ -239,6 +239,15 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         self._tseries: Dict[Tuple[str, str], Any] = {}
         self._head_loop_lag = 0.0
         self._lag_task: Optional[asyncio.Task] = None
+        # chaos fault-injection rules (fault_injection.py): the head is
+        # the distribution point — rules install here, apply to the
+        # head's own sites, and gossip to agents (push + heartbeat
+        # catch-up, version-gated like the object directory)
+        self._chaos_rules: List[Dict[str, Any]] = []
+        self._chaos_version = 0
+        # node_id -> {rule_id: fired} from heartbeats (current version
+        # only); status aggregates these with the head's own counts
+        self._chaos_fired: Dict[str, Dict[str, int]] = {}
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -435,6 +444,9 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         for pg in self.placement_groups.values():
             pg.opt_wait_used = False
         self._wake_pending_pgs()
+        if self._chaos_version:
+            # late joiners inherit the armed rule set immediately
+            asyncio.get_running_loop().call_soon(self._broadcast_chaos)
         return {"ok": True, "cluster": self._cluster_view(),
                 "version": self._cluster_version,
                 "dir_version": self._dir_version}
@@ -467,7 +479,9 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                             pending: Optional[List[Dict[str, float]]] = None,
                             objects: Optional[List[List[Any]]] = None,
                             seen_dir_version: int = -1,
-                            metrics: Optional[Dict[str, float]] = None):
+                            metrics: Optional[Dict[str, float]] = None,
+                            seen_chaos_version: int = 0,
+                            chaos_fired: Optional[Dict[str, int]] = None):
         entry = self.nodes.get(node_id)
         if entry is None:
             return {"unknown_node": True}
@@ -488,11 +502,19 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                 self._dir_version += 1
         if changed:
             self._wake_pending_pgs()
-        return {"cluster": self._cluster_view(
-                    include_objects=seen_dir_version != self._dir_version),
-                "version": self._cluster_version,
-                "dir_version": self._dir_version,
-                "scalable": self._scalable_shapes()}
+        reply = {"cluster": self._cluster_view(
+                     include_objects=seen_dir_version != self._dir_version),
+                 "version": self._cluster_version,
+                 "dir_version": self._dir_version,
+                 "scalable": self._scalable_shapes()}
+        if seen_chaos_version != self._chaos_version:
+            # catch-up for agents that missed the chaos_rules push (late
+            # join, agent restart, dropped connection)
+            reply["chaos"] = self._chaos_payload()
+        elif chaos_fired:
+            # counts only make sense against the CURRENT rule set
+            self._chaos_fired[node_id] = dict(chaos_fired)
+        return reply
 
     async def rpc_object_locations(self, oids: List[str]):
         """Directory lookup: which nodes' stores hold each oid (per the
@@ -566,6 +588,68 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         await self._on_node_dead(node_id, "drained")
         return {"ok": True}
 
+    # ---- chaos fault injection ---------------------------------------------
+
+    async def rpc_chaos(self, op: str, rule: Optional[Dict[str, Any]] = None,
+                        seed: int = 0, sites: Optional[List[str]] = None,
+                        events_per_site: int = 3, span: int = 100):
+        """Cluster-wide fault injection (see fault_injection.py):
+        op=inject adds one rule, op=schedule compiles a seed into a
+        deterministic per-site failure schedule, op=clear disarms the
+        plane, op=status reports the live rule set.  Every mutation
+        applies locally (head sites) and gossips the FULL rule set to
+        agents — a push for the fast path, the heartbeat reply as the
+        catch-up for agents that missed it."""
+        from ray_tpu._private import fault_injection
+
+        if not config.chaos_enabled:
+            raise RpcError("chaos fault injection is disabled "
+                           "(chaos_enabled=False)")
+        if op == "inject":
+            if not rule:
+                raise RpcError("chaos inject needs a rule")
+            self._chaos_rules.append(
+                fault_injection.ChaosRule.from_wire(rule).to_wire())
+        elif op == "schedule":
+            self._chaos_rules.extend(fault_injection.make_schedule(
+                seed, sites or list(fault_injection.SITES),
+                events_per_site=events_per_site, span=span))
+        elif op == "clear":
+            self._chaos_rules = []
+        elif op != "status":
+            raise RpcError(f"unknown chaos op {op!r}")
+        if op != "status":
+            self._chaos_version += 1
+            self._chaos_fired.clear()  # counts restart with the rule set
+            fault_injection.install(self._chaos_rules, self._chaos_version)
+            self._broadcast_chaos()
+        # aggregate cluster-wide firing counts: the head's own process
+        # plus the latest per-agent heartbeat reports
+        fired: Dict[str, int] = dict(fault_injection.fired_counts())
+        for counts in self._chaos_fired.values():
+            for rid, n in counts.items():
+                fired[rid] = fired.get(rid, 0) + int(n)
+        rules = [dict(r, fired=fired.get(r.get("rule_id", ""), 0))
+                 for r in self._chaos_rules]
+        return {"version": self._chaos_version, "rules": rules}
+
+    def _chaos_payload(self) -> Dict[str, Any]:
+        return {"rules": list(self._chaos_rules),
+                "version": self._chaos_version}
+
+    def _broadcast_chaos(self) -> None:
+        payload = self._chaos_payload()
+
+        async def _push_one(conn):
+            try:
+                await asyncio.wait_for(conn.push("chaos_rules", payload),
+                                       timeout=5.0)
+            except Exception:
+                pass
+
+        for conn in list(self._node_conns):
+            asyncio.ensure_future(_push_one(conn))
+
     def _cluster_view(self, include_objects: bool = True) -> Dict[str, Any]:
         """Per-node resources/labels, plus (when ``include_objects``)
         the object-directory maps — omitted for heartbeat repliers
@@ -602,6 +686,7 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
             return
         for key in [k for k in self._tseries if k[0] == node_id[:12]]:
             self._tseries.pop(key, None)  # dead node: drop its series
+        self._chaos_fired.pop(node_id, None)  # and its chaos counts
         self._cluster_version += 1
         self.mark_dirty()
         self.publish("node_events", {"event": "dead", "node_id": node_id,
@@ -766,6 +851,9 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         if actor.restarts_left > 0:
             actor.restarts_left -= 1
         actor.state = RESTARTING
+        from ray_tpu._private.metrics import fault_tolerance_metrics
+
+        fault_tolerance_metrics()[0].inc()
         self.publish("actor_events", {
             "actor_id": actor.actor_id, "state": "RESTARTING",
             "name": actor.name, "cause": cause})
@@ -857,14 +945,35 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
             node = self.nodes.get(nid)
             if node is None:
                 continue
+            # optimistic accounting: deduct the demand from the cached
+            # availability view NOW (pick_node → acquire is atomic on
+            # this loop), so concurrent creations — e.g. serve deploying
+            # N replicas — see each other's placements.  Without it
+            # SPREAD runs against identical stale views and packs every
+            # replica onto one node, which defeats fault isolation.  The
+            # next heartbeat restores ground truth either way; deduction
+            # is skipped for PG-bundled actors (they draw from reserved
+            # bundles, not the free pool).
+            deducted = (not ts.placement_group_id
+                        and node.resources.acquire(demand))
+            from ray_tpu._private import fault_injection
+
+            chaos = fault_injection.decide("lease.grant",
+                                           key=actor.actor_id)
+            if chaos is not None and chaos.action == "delay":
+                await fault_injection.sleep_async(chaos.delay_s)
             try:
                 lease = await self._node_client(node).call(
                     "request_lease", spec=actor.spec_wire, grant_only=True,
                     timeout=config.worker_lease_timeout_ms / 1000.0)
             except Exception:
+                if deducted:
+                    node.resources.release(demand)
                 await asyncio.sleep(delay)
                 continue
             if "granted" not in lease:
+                if deducted:
+                    node.resources.release(demand)
                 if lease.get("error") == "runtime env setup failed":
                     # deterministic failure: retrying other nodes cannot
                     # fix a missing/broken env package — fail fast

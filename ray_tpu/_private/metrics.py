@@ -382,6 +382,31 @@ def serve_proxy_inflight_gauge() -> Gauge:
     return _serve_inflight_gauge
 
 
+_ft_metrics: Optional[Tuple[Counter, Counter, Counter]] = None
+
+
+def fault_tolerance_metrics() -> Tuple[Counter, Counter, Counter]:
+    """Process-singleton fault-tolerance counters:
+    ``ray_tpu_actor_restarts_total`` — head-side, one per ALIVE→
+    RESTARTING transition (an actor worker/node died with restart budget
+    left); ``ray_tpu_object_reconstructions_total`` — owner-side lineage
+    reconstruction outcomes, labeled outcome=ok|failed (failed = the
+    object is permanently lost after the retry budget); and
+    ``ray_tpu_chaos_injections_total`` — one per fault-injection rule
+    firing, labeled by site (fault_injection.py)."""
+    global _ft_metrics
+    if _ft_metrics is None:
+        _ft_metrics = (
+            Counter("ray_tpu_actor_restarts_total",
+                    "actor restarts begun after a worker/node death"),
+            Counter("ray_tpu_object_reconstructions_total",
+                    "lineage reconstructions of lost objects by outcome"),
+            Counter("ray_tpu_chaos_injections_total",
+                    "chaos fault-injection rule firings by site"),
+        )
+    return _ft_metrics
+
+
 _serve_request_latency: Optional[Histogram] = None
 
 

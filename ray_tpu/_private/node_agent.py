@@ -32,6 +32,7 @@ import sys
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ray_tpu._private import fault_injection
 from ray_tpu._private.config import config
 from ray_tpu._private.errors import RuntimeEnvSetupError
 from ray_tpu._private.ids import NodeID, WorkerID
@@ -189,6 +190,8 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         # into heartbeat metric summaries for the head time-series ring
         self._log = LogMonitor(self.node_id)
         self._last_loop_lag = 0.0
+        # chaos gossip state: last rule-set version applied from the head
+        self._seen_chaos_version = 0
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -336,6 +339,52 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
                                      payload.get("version"),
                                      payload.get("scalable"),
                                      payload.get("dir_version"))
+        elif method == "chaos_rules":
+            self._apply_chaos(payload)
+
+    def _apply_chaos(self, payload: Optional[Dict[str, Any]]) -> None:
+        """Install a gossiped chaos rule set (idempotent by version) and
+        execute the imperative rules the agent owns: ``agent.kill``
+        (SIGKILL myself — the real agent-death signal, PDEATHSIG takes
+        my workers down with me) and ``worker.kill`` (SIGKILL matching
+        worker processes).  Everything else fires inline at its site."""
+        if not payload:
+            return
+        version = payload.get("version", 0)
+        if version == self._seen_chaos_version:
+            return
+        # acknowledge the version even when opted out (chaos_enabled=
+        # False), or the head re-ships the full rule set in every
+        # heartbeat reply for the life of the session
+        self._seen_chaos_version = version
+        if not config.chaos_enabled:
+            return
+        fault_injection.install(payload.get("rules", []), version)
+        self._run_chaos_kills()
+
+    def _run_chaos_kills(self) -> None:
+        chaos = fault_injection.decide("agent.kill", key=self.node_id)
+        if chaos is not None and chaos.action == "kill":
+            delay = chaos.delay_s if chaos.delay_s > 0 else 0.0
+
+            def _die():
+                os.kill(os.getpid(), signal.SIGKILL)
+
+            asyncio.get_event_loop().call_later(delay, _die)
+            return
+        for wid, w in list(self._workers.items()):
+            self._maybe_chaos_kill_worker(wid, w)
+
+    def _maybe_chaos_kill_worker(self, worker_id: str, w: "_Worker") -> None:
+        chaos = fault_injection.decide("worker.kill", key=worker_id)
+        if chaos is None or chaos.action != "kill":
+            return
+        try:
+            w.proc.kill()
+        except Exception:
+            pass
+        # the reap loop notices the death within its 0.2s poll and runs
+        # the normal worker-death path (lease release, head report)
 
     def _metric_summary(self) -> Dict[str, float]:
         """Small per-node gauge snapshot piggybacked on every heartbeat;
@@ -376,7 +425,10 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
                         int(config.locality_min_bytes),
                         int(config.object_directory_max_entries)),
                     seen_dir_version=self._seen_dir_version,
-                    metrics=self._metric_summary())
+                    metrics=self._metric_summary(),
+                    seen_chaos_version=self._seen_chaos_version,
+                    chaos_fired=fault_injection.fired_counts() or None)
+                self._apply_chaos(reply.get("chaos"))
                 if reply.get("unknown_node"):
                     # the head restarted without our entry (or reaped us
                     # during its downtime): re-register under the SAME
@@ -840,6 +892,8 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         w = self._workers.get(worker_id)
         if w is None:
             return {"ok": False}
+        # an armed worker.kill rule also catches workers born after it
+        self._maybe_chaos_kill_worker(worker_id, w)
         w.port = port
         self._starting = max(0, self._starting - 1)
         if not w.ready.is_set():
@@ -1070,6 +1124,10 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         """
         ts = TaskSpec.from_wire(spec)
         demand = ts.resource_set()
+        chaos = fault_injection.decide("lease.grant",
+                                       key=ts.actor_id or ts.function_id)
+        if chaos is not None and chaos.action == "delay":
+            await fault_injection.sleep_async(chaos.delay_s)
         if ts.placement_group_id:
             return await self._request_bundle_lease(ts, demand, _conn, req_id)
         if not grant_only:

@@ -49,6 +49,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ray_tpu._private import fault_injection
+
 _REQ = struct.Struct("<HQQ")   # oid_len (| _WRITE_FLAG), offset, length
 _RSP = struct.Struct("<BQ")    # status, length
 _OK, _NOT_FOUND = 0, 1
@@ -315,6 +317,28 @@ class ObjectTransferServer:
                 if view is None:
                     sock.sendall(_RSP.pack(_NOT_FOUND, 0))
                     continue
+                chaos = fault_injection.decide("xfer.send", key=oid)
+                if chaos is not None:
+                    if chaos.action == "delay":
+                        fault_injection.sleep_sync(chaos.delay_s)
+                    elif chaos.action == "sever":
+                        raise TransferError("chaos: stream severed")
+                    elif chaos.action == "truncate":
+                        # promise the full range, deliver half, die —
+                        # the puller hits EOF mid-payload (TransferError)
+                        # exactly as if the holder crashed mid-stripe
+                        sock.sendall(_RSP.pack(_OK, length))
+                        sock.sendall(view[:length // 2])
+                        raise TransferError("chaos: truncated mid-stripe")
+                    elif chaos.action == "corrupt":
+                        # flip bytes in a COPY (never the arena itself)
+                        buf = bytearray(view)
+                        for i in range(0, len(buf), 997):
+                            buf[i] ^= 0xFF
+                        sock.sendall(_RSP.pack(_OK, length))
+                        sock.sendall(buf)
+                        self.bytes_out += length
+                        continue
                 sock.sendall(_RSP.pack(_OK, length))
                 sock.sendall(view)
                 self.bytes_out += length

@@ -24,6 +24,8 @@ from typing import Any, Dict, Optional
 
 import msgpack
 
+from ray_tpu._private import fault_injection
+
 _REQUEST, _REPLY, _ERROR, _ONEWAY = 0, 1, 2, 3
 _LEN = struct.Struct("<I")
 _MAX_FRAME = 1 << 30
@@ -122,6 +124,15 @@ class RpcServer:
 
                     traceback.print_exc()
                     break
+                if kind in (_ONEWAY, _REQUEST):
+                    chaos = fault_injection.decide("rpc.recv", key=method)
+                    if chaos is not None:
+                        if chaos.action == "sever":
+                            break  # connection dies under the peer
+                        if chaos.action == "drop":
+                            continue  # frame read, never dispatched
+                        if chaos.action == "delay":
+                            await fault_injection.sleep_async(chaos.delay_s)
                 if kind == _ONEWAY:
                     asyncio.ensure_future(self._run_oneway(conn, method, payload))
                 elif kind == _REQUEST:
@@ -283,8 +294,14 @@ class RpcClient:
         fut = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
         try:
-            writer.write(frame)
-            await writer.drain()
+            if await self._chaos_send(method):
+                writer.write(frame)
+                await writer.drain()
+            # else: chaos "drop" — frame never hits the wire; the caller
+            # times out exactly like a frame lost by the network would
+        except ConnectionLost:
+            self._pending.pop(req_id, None)
+            raise
         except (OSError, RuntimeError, AttributeError) as e:
             self._pending.pop(req_id, None)
             raise ConnectionLost(str(e)) from e
@@ -295,6 +312,32 @@ class RpcClient:
         finally:
             self._pending.pop(req_id, None)
 
+    async def _chaos_send(self, method: str) -> bool:
+        """rpc.send chaos site.  True = write the frame; False = drop it
+        silently (the request then times out, like a frame the network
+        lost).  A "sever" decision closes the transport and raises
+        ConnectionLost, like a real mid-call connection break."""
+        if not fault_injection._rules:
+            return True  # disarmed: skip even the key formatting
+        chaos = fault_injection.decide(
+            "rpc.send", key=f"{self._label}:{method}")
+        if chaos is None:
+            return True
+        if chaos.action == "delay":
+            await fault_injection.sleep_async(chaos.delay_s)
+            return True
+        if chaos.action == "sever":
+            writer, self._writer = self._writer, None
+            if writer is not None:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+            raise ConnectionLost(
+                f"chaos: connection to {self._label or self.host}:"
+                f"{self.port} severed")
+        return False  # drop
+
     async def oneway(self, method: str, **payload) -> None:
         if self._writer is None:
             await self.connect()
@@ -302,6 +345,8 @@ class RpcClient:
         if writer is None:
             raise ConnectionLost(f"connection to {self._label or self.host}:{self.port} lost")
         try:
+            if not await self._chaos_send(method):
+                return  # chaos "drop": oneways vanish without a trace
             writer.write(_pack(_ONEWAY, 0, method, payload))
             await writer.drain()
         except (OSError, RuntimeError, AttributeError) as e:

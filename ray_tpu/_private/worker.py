@@ -442,6 +442,14 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
         self._task_queue: "queue.Queue" = queue.Queue()
         self._actor_instance: Any = None
         self._actor_creation_spec: Optional[TaskSpec] = None
+        # stateful actor restarts (__rt_save__/__rt_restore__ hooks):
+        # snapshot store handle + save cadence, guarded by a lock because
+        # max_concurrency > 1 actors finish methods on several exec
+        # threads (see _maybe_save_actor_state)
+        self._actor_state_ckpt: Any = None
+        self._actor_state_lock = threading.Lock()       # cadence counter
+        self._actor_state_save_lock = threading.Lock()  # pickle + write
+        self._actor_calls_since_save = 0
         self._pending_acks: Dict[str, Any] = {}  # task_id -> held values
         self._exec_threads: List[threading.Thread] = []
 
@@ -1068,10 +1076,33 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             except Exception:
                 pass  # agent briefly unreachable: accounting-only feature
 
+    def _reconstruction_outcome(self, oids, ok: bool) -> None:
+        """Count lineage-reconstruction outcomes
+        (ray_tpu_object_reconstructions_total{outcome=ok|failed})."""
+        if not oids:
+            return
+        from ray_tpu._private.metrics import fault_tolerance_metrics
+
+        fault_tolerance_metrics()[1].inc(
+            len(oids), tags={"outcome": "ok" if ok else "failed"})
+
+    def _lost_detail(self, refs: Sequence[ObjectRef]) -> str:
+        """Human-actionable loss report: each unrecoverable object id
+        WITH the task that produced it, so operators can tell what was
+        lost instead of just that something was."""
+        with self._lineage_lock:
+            parts = [
+                f"{ref.oid[:16]} (produced by task "
+                f"{(self._lineage_by_oid.get(ref.oid) or 'unknown')[:16]})"
+                for ref in refs[:8]]
+        more = f" … and {len(refs) - 8} more" if len(refs) > 8 else ""
+        return ", ".join(parts) + more
+
     def _get_inner(self, refs: Sequence[ObjectRef],
                    deadline: Optional[float] = None) -> List[Any]:
         out: List[Any] = [None] * len(refs)
         pending: List[Tuple[int, ObjectRef]] = list(enumerate(refs))
+        reconstructed: Set[str] = set()  # oids routed through lineage replay
         for _round in range(_MAX_RECONSTRUCTION_ROUNDS):
             plasma_fetch: List[Tuple[int, ObjectRef, Tuple[str, int]]] = []
             carry: List[Tuple[int, ObjectRef]] = []  # raced-clear retries
@@ -1121,28 +1152,35 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                     plasma_fetch.append((i, ref, node))
             if not plasma_fetch:
                 if not carry:
+                    self._reconstruction_outcome(reconstructed, ok=True)
                     return out
                 pending = carry
                 continue
             failures = self._fetch_plasma(plasma_fetch, out, deadline)
             if not failures and not carry:
+                self._reconstruction_outcome(reconstructed, ok=True)
                 return out
             # some plasma primaries are gone: reconstruct what we own,
             # report borrower-visible losses to their owners, retry
             pending = carry
             for i, ref, node, err in failures:
                 if self._maybe_reconstruct(ref.oid):
+                    reconstructed.add(ref.oid)
                     pending.append((i, ref))
                 elif ref.owner_addr is not None \
                         and tuple(ref.owner_addr) != self.address \
                         and self._report_lost_to_owner(ref, node, deadline):
                     pending.append((i, ref))
                 else:
+                    self._reconstruction_outcome({ref.oid}, ok=False)
                     raise ObjectLostError(
-                        f"object {ref.oid[:16]} was lost ({err}) and cannot "
-                        f"be reconstructed")
+                        f"object {self._lost_detail([ref])} was lost "
+                        f"({err}) and cannot be reconstructed")
+        lost_refs = [ref for _i, ref in pending]
+        self._reconstruction_outcome({r.oid for r in lost_refs}, ok=False)
         raise ObjectLostError(
-            f"gave up reconstructing after {_MAX_RECONSTRUCTION_ROUNDS} rounds")
+            f"gave up reconstructing after {_MAX_RECONSTRUCTION_ROUNDS} "
+            f"rounds; unrecoverable objects: {self._lost_detail(lost_refs)}")
 
     def _resolve_via_owner(self, ref: ObjectRef, deadline) -> Optional[Tuple[str, int]]:
         """Ask the owner where the object lives; may inline the value.
@@ -2967,6 +3005,7 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                 cls = self.functions.fetch(spec.function_id)
                 self._actor_instance = cls(*args, **kwargs)
                 self._actor_creation_spec = spec
+                self._maybe_restore_actor_state(spec)
                 if spec.max_concurrency > 1 and not self._exec_threads:
                     self._start_concurrency_threads(spec.max_concurrency - 1)
                 self.record_task_event(spec.task_id, "FINISHED")
@@ -3014,6 +3053,12 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                 # stalls every async call on this worker — same caveat
                 # as the reference's async actors.
                 value = self._run_coroutine(value)
+            if spec.kind == ACTOR_TASK \
+                    and not spec.method_name.startswith("__rt_dag_"):
+                # snapshot AFTER the method succeeded and BEFORE the
+                # caller sees the result: state the reply proves is
+                # durable enough to survive a SIGKILL right after
+                self._maybe_save_actor_state()
         except BaseException as e:
             m["failed"].inc()
             m["duration"].observe(time.time() - t0)
@@ -3034,6 +3079,77 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             # without num_returns="streaming") must produce an error
             # reply, not kill the exec thread and hang the owner's push
             return self._error_reply(spec, e, traceback.format_exc())
+
+    # ------------------------------------------- stateful actor restarts
+
+    def _actor_state_checkpoint(self, actor_id: str):
+        """Snapshot store for this worker's actor (lazy): pickled blobs
+        through train/checkpoint.py's storage layer, rooted at
+        ``actor_state_storage_path`` (default <session_dir>/actor_state,
+        reachable from every node in local clusters; point it at shared
+        storage for real multi-host deployments)."""
+        if self._actor_state_ckpt is not None:
+            return self._actor_state_ckpt
+        from ray_tpu.train.checkpoint import ActorStateCheckpoint
+        from ray_tpu.train.storage import StorageContext
+
+        root = config.actor_state_storage_path
+        if not root:
+            session = os.environ.get("RT_SESSION_DIR", "")
+            if not session:
+                return None  # nowhere durable to put snapshots
+            root = os.path.join(session, "actor_state")
+        self._actor_state_ckpt = ActorStateCheckpoint(
+            StorageContext(root), actor_id,
+            keep=int(config.actor_state_keep))
+        return self._actor_state_ckpt
+
+    def _maybe_restore_actor_state(self, spec: TaskSpec) -> None:
+        """After the constructor ran: if the class opted in
+        (``__rt_restore__``) and a previous incarnation of THIS actor id
+        saved state, replay it — a killed counter/KV/optimizer actor
+        resumes where its last completed call left it, instead of from
+        __init__ (RESTARTING → ALIVE with state)."""
+        inst = self._actor_instance
+        if not hasattr(inst, "__rt_restore__") or not spec.actor_id:
+            return
+        try:
+            ckpt = self._actor_state_checkpoint(spec.actor_id)
+            if ckpt is None or not ckpt.has_snapshot():
+                return
+            state = ckpt.load_latest()
+            if state is not None:
+                inst.__rt_restore__(state)
+        except Exception:
+            # a broken restore must not fail the (re)start — the actor
+            # comes up fresh, which is what it did before this feature
+            traceback.print_exc()
+
+    def _maybe_save_actor_state(self) -> None:
+        """After a successful actor method: persist ``__rt_save__()``
+        every ``actor_state_save_every_n`` completed calls."""
+        inst = self._actor_instance
+        if not hasattr(inst, "__rt_save__"):
+            return
+        spec = self._actor_creation_spec
+        if spec is None or not spec.actor_id:
+            return
+        # cadence bump under a short lock; the (possibly slow) pickle +
+        # write serializes on a SEPARATE lock so concurrent methods that
+        # don't save this call never queue behind an in-flight snapshot
+        with self._actor_state_lock:
+            self._actor_calls_since_save += 1
+            if self._actor_calls_since_save \
+                    < max(1, int(config.actor_state_save_every_n)):
+                return
+            self._actor_calls_since_save = 0
+        with self._actor_state_save_lock:
+            try:
+                ckpt = self._actor_state_checkpoint(spec.actor_id)
+                if ckpt is not None:
+                    ckpt.save(inst.__rt_save__())
+            except Exception:
+                traceback.print_exc()  # snapshot loss, not call failure
 
     def _stream_out(self, spec: TaskSpec, value: Any,
                     conn) -> Dict[str, Any]:

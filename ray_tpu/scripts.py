@@ -397,6 +397,33 @@ def cmd_summary(args) -> int:
     return 0
 
 
+def cmd_chaos(args) -> int:
+    """`rtpu chaos inject|schedule|clear|status`: drive the cluster's
+    fault-injection plane through the head's chaos RPC (the head applies
+    rules locally and gossips them to every agent)."""
+    head, io = _head_client(_resolve_address(args.address))
+    try:
+        if args.chaos_cmd == "inject":
+            reply = head.call("chaos", op="inject", rule={
+                "site": args.site, "action": args.action, "p": args.p,
+                "count": args.count, "delay_s": args.delay,
+                "target": args.target, "seed": args.seed})
+        elif args.chaos_cmd == "schedule":
+            reply = head.call(
+                "chaos", op="schedule", seed=args.seed,
+                sites=[s for s in args.sites.split(",") if s],
+                events_per_site=args.events_per_site, span=args.span)
+        elif args.chaos_cmd == "clear":
+            reply = head.call("chaos", op="clear")
+        else:
+            reply = head.call("chaos", op="status")
+        print(json.dumps(reply, indent=2))
+    finally:
+        head.close()
+        io.stop()
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Inspect distributed traces straight off the head's trace store
     (no driver attach needed — plain head RPCs)."""
@@ -530,6 +557,41 @@ def main(argv=None) -> int:
     p.add_argument("-o", "--output", default="timeline.json")
     p.add_argument("--address", default="")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser(
+        "chaos", help="deterministic fault injection (chaos engineering)")
+    p.add_argument("--address", default="")
+    csub = p.add_subparsers(dest="chaos_cmd", required=True)
+    ci = csub.add_parser("inject", help="arm one fault-injection rule")
+    ci.add_argument("--site", required=True,
+                    help="rpc.send|rpc.recv|xfer.send|lease.grant|"
+                         "worker.kill|agent.kill")
+    ci.add_argument("--action", required=True,
+                    help="drop|delay|sever|truncate|corrupt|kill")
+    ci.add_argument("--p", type=float, default=1.0,
+                    help="firing probability per matching invocation")
+    ci.add_argument("--count", type=int, default=-1,
+                    help="max firings PER PROCESS (-1 = unlimited): every "
+                         "agent enforces its own cap — scope cluster-wide "
+                         "one-shots with --target")
+    ci.add_argument("--delay", type=float, default=0.05,
+                    help="seconds, for --action delay")
+    ci.add_argument("--target", default="",
+                    help="substring match on the site key "
+                         "(worker id, node id, method, oid)")
+    ci.add_argument("--seed", type=int, default=None)
+    cs = csub.add_parser(
+        "schedule", help="compile a seed into a reproducible failure "
+                         "schedule across sites")
+    cs.add_argument("--seed", type=int, required=True)
+    cs.add_argument("--sites", default="rpc.send,rpc.recv",
+                    help="comma-separated site list")
+    cs.add_argument("--events-per-site", type=int, default=3)
+    cs.add_argument("--span", type=int, default=100,
+                    help="invocation horizon the events land in")
+    csub.add_parser("clear", help="disarm every rule cluster-wide")
+    csub.add_parser("status", help="live rule set + firing counts")
+    p.set_defaults(fn=cmd_chaos)
 
     p = sub.add_parser("trace", help="inspect distributed traces")
     p.add_argument("--address", default="")

@@ -7,6 +7,7 @@ batching.py).  TPU slant: @serve.batch coalesces concurrent requests
 into one jitted forward, the TPU-efficient serving shape.
 """
 
+from ray_tpu._private.errors import DeploymentFailedError
 from ray_tpu.serve.api import (Application, Deployment, DeploymentHandle,
                                batch, delete, deployment, get_handle, run,
                                shutdown)
@@ -19,4 +20,4 @@ __all__ = ["deployment", "run", "get_handle", "delete", "shutdown",
            "batch", "Deployment", "DeploymentHandle", "Application",
            "start_http", "start_per_node_http", "proxy_addresses",
            "shutdown_http", "start_rpc_ingress", "stop_rpc_ingress",
-           "RpcIngressClient"]
+           "RpcIngressClient", "DeploymentFailedError"]

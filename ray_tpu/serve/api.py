@@ -390,7 +390,8 @@ class ServeController:
     def deploy(self, name: str, target_blob: bytes, num_replicas: int,
                max_ongoing: int, init_args, init_kwargs,
                actor_options: Dict[str, Any],
-               autoscaling: Optional[Dict[str, Any]] = None):
+               autoscaling: Optional[Dict[str, Any]] = None,
+               health_timeout: Optional[float] = None):
         import ray_tpu
 
         if autoscaling:
@@ -409,19 +410,35 @@ class ServeController:
             "version": 0,
             "ongoing": {},   # handle_id -> (reported count, timestamp)
         }
+        from ray_tpu._private.config import config
+        from ray_tpu._private.errors import (DeploymentFailedError,
+                                             GetTimeoutError)
+
         # blue-green: bring the new replicas up FIRST; a failing redeploy
         # must not take down a working deployment
         replicas = [self._start_replica(app, name)
                     for _ in range(num_replicas)]
+        # the caller's (driver's) config wins: the controller process may
+        # have been spawned before the driver set the knob
+        if health_timeout is None:
+            health_timeout = float(config.serve_replica_health_timeout_s)
         try:
-            # block until every replica's constructor finished (model loaded)
-            ray_tpu.get([r.health.remote() for r in replicas], timeout=600)
-        except ray_tpu.RayError:
+            # block until every replica's constructor finished (model
+            # loaded); bounded so ONE wedged replica can't stall the
+            # deploy indefinitely (was a hardcoded 600s)
+            ray_tpu.get([r.health.remote() for r in replicas],
+                        timeout=health_timeout)
+        except ray_tpu.RayError as e:
             for r in replicas:
                 try:
                     ray_tpu.kill(r)
                 except Exception:
                     pass
+            if isinstance(e, GetTimeoutError):
+                raise DeploymentFailedError(
+                    f"deployment {name!r}: replicas did not pass the "
+                    f"health check within serve_replica_health_timeout_s="
+                    f"{health_timeout:g}s") from e
             raise
         app["replicas"] = replicas
         with self._lock:
@@ -926,27 +943,35 @@ class DeploymentHandle:
                 self._set_replicas(info["replica_ids"],
                                    info.get("replica_nodes"))
 
-    def _pick_replica(self, local_pref: bool = True):
+    def _pick_replica(self, local_pref: bool = True, exclude=None):
         """Choose a replica (least-outstanding-requests) and charge it
-        +1 inflight; returns (replica, rid)."""
+        +1 inflight; returns (replica, rid).  ``exclude`` filters out
+        replicas a retrying caller already saw die — unless that would
+        leave nothing, in which case every replica is fair game again
+        (the exclusion list may be stale across a re-heal)."""
         import random
 
         with self._lock:
             if not self._replicas:
                 raise RuntimeError(
                     f"deployment {self._name!r} has no replicas")
+            candidates = self._replicas
+            if exclude:
+                alive = [r for r in candidates
+                         if r._actor_id not in exclude]
+                candidates = alive or candidates
             # locality-aware power-of-two (reference:
             # pow_2_scheduler.py:717): prefer same-node replicas only
             # while they have queue headroom — a saturated local replica
             # must not absorb all ingress while remote ones sit idle —
             # then sample two candidates, take the fewer-outstanding one
-            local = [r for r in self._replicas
+            local = [r for r in candidates
                      if self._replica_nodes.get(r._actor_id)
                      == self._my_node
                      and self._inflight.get(r._actor_id, 0)
                      < self._max_ongoing] \
                 if (local_pref and self._my_node) else []
-            pool = local or self._replicas
+            pool = local or candidates
             if len(pool) > 2:
                 pool = random.sample(pool, 2)
             replica = min(pool,
@@ -1003,6 +1028,53 @@ class DeploymentHandle:
             await self._refresh_async(force=True)
         replica, rid = self._pick_replica()
         return self._submit_call(replica, rid, _method, args, kwargs)
+
+    def _drop_replica(self, rid: str) -> None:
+        """A call to this replica died: stop routing to it NOW, without
+        waiting for the next controller refresh — during node churn the
+        refresh window would otherwise keep feeding a dead replica."""
+        with self._lock:
+            self._replicas = [r for r in self._replicas
+                              if r._actor_id != rid]
+            self._replica_nodes.pop(rid, None)
+            self._inflight.pop(rid, None)
+
+    async def call_async(self, *args, _method: str = "__call__",
+                         _timeout: float = 120.0, **kwargs):
+        """Submit AND await one call, retrying dead replicas: if the
+        picked replica died mid-flight (its node was SIGKILLed under
+        load), the request is re-sent to a surviving replica instead of
+        surfacing ActorDiedError to the client — graceful degradation
+        under churn.  User exceptions (RayTaskError) are NEVER retried;
+        only replica-death errors are, ``serve_dead_replica_retries``
+        times, with a forced controller refresh between attempts."""
+        import ray_tpu
+        from ray_tpu._private.config import config
+        from ray_tpu._private.errors import (ActorDiedError,
+                                             ActorUnavailableError,
+                                             RayWorkerError)
+
+        await self._refresh_async()
+        if not self._replicas:
+            await self._refresh_async(force=True)
+        attempts = 1 + max(0, int(config.serve_dead_replica_retries))
+        dead: set = set()
+        for attempt in range(attempts):
+            if not self._replicas:
+                await self._refresh_async(force=True)
+            replica, rid = self._pick_replica(exclude=dead)
+            ref = self._submit_call(replica, rid, _method, args, kwargs)
+            try:
+                return await ray_tpu.get_async(ref, timeout=_timeout)
+            except (ActorDiedError, ActorUnavailableError,
+                    RayWorkerError):
+                dead.add(rid)
+                self._drop_replica(rid)
+                if attempt == attempts - 1:
+                    raise
+                # the controller may have re-healed already; otherwise
+                # surviving cached replicas keep serving
+                await self._refresh_async(force=True)
 
     def _submit_stream(self, replica, rid: str, _method: str, args, kwargs):
         """Submit one streaming replica call; returns (gen, release)."""
@@ -1124,14 +1196,23 @@ def run(app: Application, name: Optional[str] = None) -> DeploymentHandle:
     import cloudpickle
 
     import ray_tpu
+    from ray_tpu._private.config import config
+    from ray_tpu._private.errors import DeploymentFailedError
 
     d = app.deployment
     dep_name = name or d.name
     ctrl = _controller()
-    ray_tpu.get(ctrl.deploy.remote(
-        dep_name, cloudpickle.dumps(d.func_or_class), d.num_replicas,
-        d.max_ongoing_requests, d.init_args, d.init_kwargs,
-        d.ray_actor_options, d.autoscaling_config), timeout=600)
+    try:
+        ray_tpu.get(ctrl.deploy.remote(
+            dep_name, cloudpickle.dumps(d.func_or_class), d.num_replicas,
+            d.max_ongoing_requests, d.init_args, d.init_kwargs,
+            d.ray_actor_options, d.autoscaling_config,
+            float(config.serve_replica_health_timeout_s)),
+            timeout=float(config.serve_replica_health_timeout_s) + 120.0)
+    except ray_tpu.RayTaskError as e:
+        if isinstance(e.cause, DeploymentFailedError):
+            raise e.cause from None  # typed: callers can catch it
+        raise
     return get_handle(dep_name)
 
 

@@ -489,20 +489,23 @@ class _HttpProxy:
         return "200 OK", payload, None
 
     async def _call_async(self, name: str, arg: Any):
-        """The hot path: submit via remote_async, await the reply ref on
-        this loop — no executor thread anywhere.  A stale cached handle
-        (replicas replaced wholesale) refreshes once, like the sync
-        path always did."""
+        """The hot path: submit + await through the handle's
+        dead-replica-retrying call_async on this loop — no executor
+        thread anywhere.  A request whose replica died mid-flight (node
+        churn) is transparently re-sent to a surviving replica inside
+        the handle; the proxy-level fallback below additionally covers
+        wholesale replica replacement (stale cached handle) by
+        refreshing the handle once, like the sync path always did."""
         import ray_tpu
 
         handle = await self._resolve_handle_async(name)
         try:
-            ref = await handle.remote_async(arg)
-            return await ray_tpu.get_async(ref, timeout=120)
+            return await handle.call_async(arg, _timeout=120)
+        except ray_tpu.RayTaskError:
+            raise  # user exception: retrying cannot change the outcome
         except ray_tpu.RayError:
             handle = await self._resolve_handle_async(name, fresh=True)
-            ref = await handle.remote_async(arg)
-            return await ray_tpu.get_async(ref, timeout=120)
+            return await handle.call_async(arg, _timeout=120)
 
     async def _stream_async_values(self, name: str, arg: Any):
         """Async iterator of ITEM VALUES for an SSE response.  The

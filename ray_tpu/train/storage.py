@@ -111,6 +111,43 @@ class StorageContext:
 
     # ------------------------------------------------------------ files
 
+    def write_bytes(self, rel: str, data: bytes) -> None:
+        """Binary sibling of write_text (actor-state snapshots ride
+        this); local writes are atomic tmp+rename like the text path."""
+        if self.protocol == "file":
+            path = posixpath.join(self.experiment_path, rel)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+            return
+        with self.fs.open(posixpath.join(self.experiment_path, rel),
+                          "wb") as f:
+            f.write(data)
+
+    def read_bytes(self, rel: str) -> Optional[bytes]:
+        try:
+            if self.protocol == "file":
+                with open(posixpath.join(self.experiment_path, rel),
+                          "rb") as f:
+                    return f.read()
+            with self.fs.open(posixpath.join(self.experiment_path, rel),
+                              "rb") as f:
+                return f.read()
+        except (OSError, FileNotFoundError):
+            return None
+
+    def remove(self, rel: str) -> None:
+        """Best-effort single-file delete (snapshot eviction)."""
+        try:
+            if self.protocol == "file":
+                os.remove(posixpath.join(self.experiment_path, rel))
+            else:
+                self.fs.rm_file(posixpath.join(self.experiment_path, rel))
+        except (OSError, FileNotFoundError):
+            pass
+
     def write_text(self, rel: str, text: str) -> None:
         if self.protocol == "file":
             path = posixpath.join(self.experiment_path, rel)
