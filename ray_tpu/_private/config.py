@@ -54,6 +54,21 @@ _def("object_transfer_sock_buf_bytes", 4 * 1024 * 1024)  # SO_SNDBUF/SO_RCVBUF
 # entries piggybacked on heartbeats (0 disables locality scheduling)
 _def("locality_min_bytes", 1024 * 1024)
 _def("object_directory_max_entries", 128)  # per-node heartbeat summary cap
+# head object directory shard count: independent lock+version per
+# oid-hash bucket, so heartbeat deltas / lookups / gossip on different
+# buckets never serialize on one structure (see object_directory.py)
+_def("object_directory_shards", 16)
+# --- dispatch batching (see worker.py owner pump) ----------------------------
+# max leases one batched request_leases frame may ask an agent for
+_def("lease_request_batch_max", 16)
+# executor-side result micro-batching: flush a batch_results frame when
+# this many are buffered, or this many ms after the first
+_def("dispatch_result_batch_max", 32)
+_def("dispatch_result_flush_ms", 5)
+# how long an agent waits after an owner's connection drops before
+# reclaiming its leases — a transiently-dropped owner re-binds them on
+# its next lease request within this window
+_def("lease_orphan_grace_s", 3.0)
 # --- control plane ----------------------------------------------------------
 _def("gcs_health_check_period_ms", 3_000)   # ref: ray_config_def.h:841-847
 _def("gcs_health_check_failure_threshold", 5)

@@ -132,7 +132,7 @@ class _ActorEntry:
 class _NodeEntry:
     __slots__ = ("node_id", "host", "port", "arena_path", "resources",
                  "last_heartbeat", "client", "is_head_node",
-                 "pending_demands", "labels", "xfer_port", "objects")
+                 "pending_demands", "labels", "xfer_port")
 
     def __init__(self, node_id: str, host: str, port: int, arena_path: str,
                  resources: NodeResources, is_head_node: bool,
@@ -154,12 +154,8 @@ class _NodeEntry:
         self.labels: Dict[str, str] = labels or {}
         # bulk object-transfer plane listener (object_transfer.py)
         self.xfer_port = xfer_port
-        # object directory: large sealed objects on this node's store
-        # ({oid: size}, heartbeat snapshots) — the cluster-view copy lets
-        # spillback locality scoring see copies the submitter's hints
-        # don't know about, and feeds multi-source pull retry
-        # (reference: the GCS-backed ObjectDirectory)
-        self.objects: Dict[str, int] = {}
+        # NOTE: object locations live in HeadService.dir (the sharded
+        # object directory), no longer per-node snapshot maps here
 
     def table_entry(self) -> Dict[str, Any]:
         return {
@@ -186,11 +182,18 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         self._persist_task: Optional[asyncio.Task] = None
         self._node_conns: Dict[Any, str] = {}  # conn -> node_id
         self._cluster_version = 0  # bumped on membership change
-        # bumped whenever any node's object-directory snapshot changes;
-        # heartbeat replies omit the (potentially large) per-node
-        # `objects` maps for agents already at this version, so
-        # directory gossip costs O(nodes) only while objects churn
-        self._dir_version = 0
+        # sharded object directory (object_directory.py): per-oid-hash
+        # buckets, each with its own lock + version — heartbeat deltas,
+        # location lookups, and mirror gossip on different buckets never
+        # serialize on one structure.  The epoch token handshakes full
+        # re-sends across head restarts.
+        import os as _os
+
+        from ray_tpu._private.object_directory import ShardedObjectDirectory
+
+        self.dir = ShardedObjectDirectory(
+            int(config.object_directory_shards),
+            epoch=_os.urandom(8).hex())
         self._shutdown = asyncio.Event()
         # general pub/sub: per-channel ring buffer + long-poll waiters
         # (reference: pubsub/publisher.h:307 — typed channels for node
@@ -207,8 +210,12 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         # node types an autoscaler announced it can launch
         self._autoscaler_types: Dict[str, Dict[str, Any]] = {}
         # task-event store: merged record per task, insertion-ordered so
-        # the oldest fall off at the cap (reference: gcs_task_manager.h)
+        # the oldest fall off at the cap (reference: gcs_task_manager.h).
+        # Incoming frames queue in _ev_inbox and merge once per loop
+        # tick (see rpc_task_events)
         self.task_events: Dict[str, Dict[str, Any]] = {}
+        self._ev_inbox: List[List[Dict[str, Any]]] = []
+        self._ev_drain_scheduled = False
         # trace store: trace_id -> {spans, start, end, root}, insertion-
         # ordered and bounded like the task-event store (see tracing.py)
         self.traces: Dict[str, Dict[str, Any]] = {}
@@ -449,7 +456,8 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
             asyncio.get_running_loop().call_soon(self._broadcast_chaos)
         return {"ok": True, "cluster": self._cluster_view(),
                 "version": self._cluster_version,
-                "dir_version": self._dir_version}
+                "dir_epoch": self.dir.epoch,
+                "dir": self.dir.updates_since(None)}
 
     def _broadcast_cluster_view(self):
         """Membership changed: push the fresh view to every agent so
@@ -458,7 +466,6 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         wedged agent can't stall the others."""
         view = self._cluster_view()
         version = self._cluster_version
-        dir_version = self._dir_version
         scalable = self._scalable_shapes()
 
         async def _push_one(conn):
@@ -466,7 +473,6 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                 await asyncio.wait_for(
                     conn.push("cluster_update",
                               {"cluster": view, "version": version,
-                               "dir_version": dir_version,
                                "scalable": scalable}),
                     timeout=5.0)
             except Exception:
@@ -477,8 +483,8 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
 
     async def rpc_heartbeat(self, node_id: str, available: Dict[str, float],
                             pending: Optional[List[Dict[str, float]]] = None,
-                            objects: Optional[List[List[Any]]] = None,
-                            seen_dir_version: int = -1,
+                            objects_delta: Optional[Dict[str, Any]] = None,
+                            dir_versions: Optional[List[int]] = None,
                             metrics: Optional[Dict[str, float]] = None,
                             seen_chaos_version: int = 0,
                             chaos_fired: Optional[Dict[str, int]] = None):
@@ -494,18 +500,24 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         changed = fresh != entry.resources.available
         entry.resources.available = fresh
         entry.pending_demands = pending or []
-        if objects is not None:
-            # full snapshot each beat: removals need no tombstones
-            snap = {oid: size for oid, size in objects}
-            if snap != entry.objects:
-                entry.objects = snap
-                self._dir_version += 1
+        if objects_delta is not None:
+            # delta vs what this agent last acked — applied per shard,
+            # bumping only the touched shards' versions.  A delta built
+            # against a stale epoch (head restarted underneath the
+            # agent) is only safe if it is a full re-send; otherwise the
+            # epoch in our reply makes the agent re-send everything.
+            if objects_delta.get("full") \
+                    or objects_delta.get("epoch") == self.dir.epoch:
+                self.dir.apply_delta(
+                    node_id, objects_delta.get("add") or (),
+                    objects_delta.get("remove") or (),
+                    full=bool(objects_delta.get("full")))
         if changed:
             self._wake_pending_pgs()
-        reply = {"cluster": self._cluster_view(
-                     include_objects=seen_dir_version != self._dir_version),
+        reply = {"cluster": self._cluster_view(),
                  "version": self._cluster_version,
-                 "dir_version": self._dir_version,
+                 "dir_epoch": self.dir.epoch,
+                 "dir": self.dir.updates_since(dir_versions),
                  "scalable": self._scalable_shapes()}
         if seen_chaos_version != self._chaos_version:
             # catch-up for agents that missed the chaos_rules push (late
@@ -518,14 +530,16 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
 
     async def rpc_object_locations(self, oids: List[str]):
         """Directory lookup: which nodes' stores hold each oid (per the
-        latest heartbeat summaries).  Pullers use it to retry from an
+        latest heartbeat deltas).  Pullers use it to retry from an
         alternate holder when their recorded source died mid-transfer
-        (reference: ObjectDirectory location subscriptions)."""
+        (reference: ObjectDirectory location subscriptions).  One shard
+        lock per oid — no scan over every node's object map."""
         out: Dict[str, List[List[Any]]] = {}
         for oid in oids:
             holders = []
-            for n in self.nodes.values():
-                if oid in n.objects:
+            for nid in self.dir.locations(oid):
+                n = self.nodes.get(nid)
+                if n is not None:
                     holders.append([n.host, n.port])
             if holders:
                 out[oid] = holders
@@ -650,19 +664,13 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         for conn in list(self._node_conns):
             asyncio.ensure_future(_push_one(conn))
 
-    def _cluster_view(self, include_objects: bool = True) -> Dict[str, Any]:
-        """Per-node resources/labels, plus (when ``include_objects``)
-        the object-directory maps — omitted for heartbeat repliers
-        already at the current dir_version; agents then retain the
-        objects from their cached view."""
-        view: Dict[str, Any] = {}
-        for nid, n in self.nodes.items():
-            entry = {"addr": [n.host, n.port], "res": n.resources.to_dict(),
-                     "labels": n.labels, "xfer": n.xfer_port}
-            if include_objects:
-                entry["objects"] = n.objects
-            view[nid] = entry
-        return view
+    def _cluster_view(self) -> Dict[str, Any]:
+        """Per-node resources/labels.  Object locations ride the sharded
+        directory's versioned shard updates, not this view."""
+        return {nid: {"addr": [n.host, n.port],
+                      "res": n.resources.to_dict(),
+                      "labels": n.labels, "xfer": n.xfer_port}
+                for nid, n in self.nodes.items()}
 
     def on_peer_disconnect(self, conn) -> None:
         node_id = self._node_conns.pop(conn, None)
@@ -687,6 +695,7 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         for key in [k for k in self._tseries if k[0] == node_id[:12]]:
             self._tseries.pop(key, None)  # dead node: drop its series
         self._chaos_fired.pop(node_id, None)  # and its chaos counts
+        self.dir.drop_node(node_id)  # its object copies died with it
         self._cluster_version += 1
         self.mark_dirty()
         self.publish("node_events", {"event": "dead", "node_id": node_id,
@@ -1101,7 +1110,11 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         await self._schedule_pg(entry, max_attempts=1, inline=True)
         if entry.state == PG_PENDING:
             asyncio.ensure_future(self._schedule_pg(entry))
-        return {"pg_id": pg_id}
+        # the reply carries the full info when the inline pass already
+        # committed the group: the client's ready()/wait() then answers
+        # from this snapshot with ZERO further round trips — on the PG
+        # churn path that removes one of the three driver RPCs
+        return {"pg_id": pg_id, "info": entry.info(self.nodes)}
 
     async def rpc_get_placement_group(self, pg_id: str, wait: bool = False,
                                       wait_s: Optional[float] = None):
@@ -1130,17 +1143,25 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         entry.state = PG_REMOVED
         self.mark_dirty()
         entry.wake()
+        # one return_bundles frame per node instead of one RPC per
+        # bundle (the release half of the batched PG commit path)
+        by_node: Dict[str, List[int]] = {}
         for idx, nid in enumerate(entry.placements):
-            node = self.nodes.get(nid) if nid else None
-            if node is not None:
-                try:
-                    await self._node_client(node).call(
-                        "return_bundle", pg_id=pg_id, bundle_index=idx)
-                except Exception:
-                    pass
-                # update the cached view immediately — the next PG create
-                # must not wait out a heartbeat period to see the freed
-                # capacity (heartbeats remain authoritative and overwrite)
+            if nid is not None:
+                by_node.setdefault(nid, []).append(idx)
+        for nid, idxs in by_node.items():
+            node = self.nodes.get(nid)
+            if node is None:
+                continue
+            try:
+                await self._node_client(node).call(
+                    "return_bundles", pg_id=pg_id, indices=idxs)
+            except Exception:
+                pass
+            # update the cached view immediately — the next PG create
+            # must not wait out a heartbeat period to see the freed
+            # capacity (heartbeats remain authoritative and overwrite)
+            for idx in idxs:
                 node.resources.release(ResourceSet(entry.bundles[idx]))
         self._wake_pending_pgs()
         return {"ok": True}
@@ -1316,39 +1337,63 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                           wait_ms: int = 0):
         """Reserve every bundle; roll back on any failure (all-or-nothing —
         the TPU-slice gang atomicity guarantee).  Returns
-        (ok, newly_reserved_bundle_indices)."""
+        (ok, newly_reserved_bundle_indices).
+
+        All of a node's bundles ride ONE reserve_bundles frame: an
+        N-host slice costs O(nodes) commit round trips, not O(bundles)
+        (ISSUE 8 satellite — PG commits batch along the lease-frame
+        path)."""
         newly_reserved: List[int] = []
+        ok = True
+        by_node: List[Tuple[str, List[int]]] = []
         for idx, nid in enumerate(plan):
+            if by_node and by_node[-1][0] == nid:
+                by_node[-1][1].append(idx)
+            else:
+                by_node.append((nid, [idx]))
+        for nid, idxs in by_node:
+            if not ok:
+                break
             node = self.nodes.get(nid)
             if node is None:
+                ok = False
                 break
             try:
                 r = await self._node_client(node).call(
-                    "reserve_bundle", pg_id=entry.pg_id, bundle_index=idx,
-                    resources=entry.bundles[idx], wait_ms=wait_ms)
+                    "reserve_bundles", pg_id=entry.pg_id,
+                    items=[[i, entry.bundles[i]] for i in idxs],
+                    wait_ms=wait_ms)
+                results = list(r.get("results") or [])
             except Exception:
-                r = {"ok": False}
+                results = []
                 # the RPC failed on OUR side (connection drop) but the
                 # agent-side handler may still be waiting — or may grant
                 # later; make sure nothing stays carved out for an
                 # attempt we are abandoning (best-effort: the agent also
                 # rolls back grants whose caller connection closed)
-                asyncio.ensure_future(self._abort_bundle_reservation(
-                    nid, entry.pg_id, idx))
-            if not r.get("ok"):
-                break
-            if not r.get("already"):
-                # only bundles reserved by THIS attempt may be rolled
-                # back; pre-existing ones carry live workloads
-                newly_reserved.append(idx)
-        else:
+                for i in idxs:
+                    asyncio.ensure_future(self._abort_bundle_reservation(
+                        nid, entry.pg_id, i))
+            results += [{"ok": False}] * (len(idxs) - len(results))
+            for i, rr in zip(idxs, results):
+                if not rr.get("ok"):
+                    ok = False
+                    break
+                if not rr.get("already"):
+                    # only bundles reserved by THIS attempt may be rolled
+                    # back; pre-existing ones carry live workloads
+                    newly_reserved.append(i)
+        if ok:
             return True, newly_reserved
+        rollback: Dict[str, List[int]] = {}
         for idx in newly_reserved:
-            node = self.nodes.get(plan[idx])
+            rollback.setdefault(plan[idx], []).append(idx)
+        for nid, idxs in rollback.items():
+            node = self.nodes.get(nid)
             if node is not None:
                 try:
                     await self._node_client(node).call(
-                        "return_bundle", pg_id=entry.pg_id, bundle_index=idx)
+                        "return_bundles", pg_id=entry.pg_id, indices=idxs)
                 except Exception:
                     pass
         return False, []
@@ -1570,7 +1615,30 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
 
     async def rpc_task_events(self, events: List[Dict[str, Any]]):
         """Workers flush task state transitions here in batches
-        (reference: task_event_buffer.h -> gcs_task_manager.h)."""
+        (reference: task_event_buffer.h -> gcs_task_manager.h).
+
+        Frames land in an inbox drained ONCE per loop tick: with many
+        clients flushing a burst simultaneously, the merge + cap-trim +
+        latency-histogram pass runs over all of them together instead
+        of per frame — the head-side half of the event batching."""
+        self._ev_inbox.append(events)
+        if not self._ev_drain_scheduled:
+            self._ev_drain_scheduled = True
+            asyncio.get_running_loop().call_soon(self._drain_task_events)
+        return {"ok": True}
+
+    def _drain_task_events(self) -> None:
+        self._ev_drain_scheduled = False
+        batches, self._ev_inbox = self._ev_inbox, []
+        for events in batches:
+            self._apply_task_events(events)
+        cap = config.task_events_buffer_size
+        while len(self.task_events) > cap:
+            oldest = next(iter(self.task_events))
+            self.task_events.pop(oldest)
+            self._sched_observed.pop(oldest, None)
+
+    def _apply_task_events(self, events: List[Dict[str, Any]]) -> None:
         rank = {"SUBMITTED": 0, "LEASED": 1, "RUNNING": 2,
                 "FINISHED": 3, "FAILED": 3}
         for ev in events:
@@ -1591,12 +1659,6 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                         continue
                 rec[k] = v
             self._observe_sched_latency(rec)
-        cap = config.task_events_buffer_size
-        while len(self.task_events) > cap:
-            oldest = next(iter(self.task_events))
-            self.task_events.pop(oldest)
-            self._sched_observed.pop(oldest, None)
-        return {"ok": True}
 
     def _observe_sched_latency(self, rec: Dict[str, Any]) -> None:
         """Once a task record is terminal, decompose its lifetime into

@@ -382,6 +382,43 @@ def serve_proxy_inflight_gauge() -> Gauge:
     return _serve_inflight_gauge
 
 
+_task_event_dropped: Optional[Counter] = None
+
+
+def task_events_dropped_counter() -> Counter:
+    """Process-singleton ``ray_tpu_task_events_dropped_total``: task
+    state-transition records discarded because the owner-side event
+    buffer overflowed (``task_events_buffer_size``) before a flush could
+    drain it.  A nonzero rate means the observability plane is lossy —
+    raise the buffer or investigate a wedged flush; the drop itself is
+    deliberate (events must never backpressure the submit hot path)."""
+    global _task_event_dropped
+    if _task_event_dropped is None:
+        _task_event_dropped = Counter(
+            "ray_tpu_task_events_dropped_total",
+            "task events dropped on owner-side buffer overflow")
+    return _task_event_dropped
+
+
+_dispatch_batch_hist: Optional[Histogram] = None
+
+
+def dispatch_batch_size_histogram() -> Histogram:
+    """Process-singleton ``ray_tpu_dispatch_batch_size``: tasks carried
+    per owner→worker push frame (1 = the unbatched direct call).  The
+    companion gauge to ``ray_tpu_dispatch_pump_depth`` when hunting a
+    tasks/s plateau: high pump depth with batch size pinned at 1 means
+    the pump is fragmenting — frames, not payload bytes, cap small-task
+    throughput."""
+    global _dispatch_batch_hist
+    if _dispatch_batch_hist is None:
+        _dispatch_batch_hist = Histogram(
+            "ray_tpu_dispatch_batch_size",
+            "tasks per owner-side push_tasks frame",
+            boundaries=[1, 2, 4, 8, 16, 32, 64])
+    return _dispatch_batch_hist
+
+
 _ft_metrics: Optional[Tuple[Counter, Counter, Counter]] = None
 
 

@@ -377,6 +377,12 @@ class EventLoopThread:
         return fut.result(timeout)
 
     def spawn(self, coro):
+        if threading.current_thread() is self._thread:
+            # already on the loop: create_task directly —
+            # run_coroutine_threadsafe would pay a self-pipe wakeup
+            # SYSCALL per call even from the loop thread, and the
+            # dispatch pump spawns a push per batch on the hot path
+            return self.loop.create_task(coro)
         return asyncio.run_coroutine_threadsafe(coro, self.loop)
 
     def stop(self):

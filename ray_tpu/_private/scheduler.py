@@ -37,6 +37,16 @@ class LocalScheduler:
             return False
         return self.resources.acquire(demand)
 
+    def acquire_many(self, demand: ResourceSet, max_n: int) -> int:
+        """Acquire up to ``max_n`` copies of ``demand`` immediately
+        (FIFO-respecting: nothing while older requests queue).  Returns
+        how many were acquired — the grant count of one batched
+        request_leases frame (see node_agent.rpc_request_leases)."""
+        n = 0
+        while n < max_n and self.try_acquire(demand):
+            n += 1
+        return n
+
     def enqueue(self, token: object, demand: ResourceSet) -> None:
         self._queue.append((token, demand))
 

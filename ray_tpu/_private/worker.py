@@ -264,13 +264,20 @@ class _ServiceStats:
 
 
 class _SchedState:
-    __slots__ = ("key", "pending", "leases", "inflight_requests", "stats",
-                 "request_agents", "req_counter", "pump_queued",
-                 "defer_timer", "req_rr")
+    __slots__ = ("key", "pending", "staged", "lock", "leases",
+                 "inflight_requests", "stats", "request_agents",
+                 "req_counter", "pump_queued", "defer_timer", "req_rr")
 
     def __init__(self, key: tuple = ()):
         self.key = key
         self.pending: deque = deque()
+        # cross-thread submission staging: the caller thread appends
+        # here under this class's OWN lock (not a process-global one),
+        # so submitters of different scheduling classes, the reply path,
+        # and the event-flush path never contend on one lock.  The pump
+        # drains staged -> pending in one pass on the IO loop.
+        self.staged: deque = deque()
+        self.lock = threading.Lock()
         self.leases: List[_Lease] = []
         self.inflight_requests = 0
         # True while a deferred-locality re-pump timer is scheduled
@@ -286,10 +293,10 @@ class _SchedState:
         # (reference: CancelWorkerLease in node_manager.proto)
         self.request_agents: Dict[str, Tuple[str, int]] = {}
         self.req_counter = 0
-        # True while a coalesced pump callback is queued on the loop:
-        # rapid-fire submissions accumulate in pending and get assigned
+        # True while a coalesced pump wakeup is queued on the loop:
+        # rapid-fire submissions accumulate in staged and get assigned
         # in ONE pump (forming real push_tasks batches) instead of one
-        # pump per submission
+        # pump per submission; guarded by `lock`
         self.pump_queued = False
 
 
@@ -390,6 +397,7 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
         self._task_events: List[Dict[str, Any]] = []
         self._task_events_lock = threading.Lock()
         self._flush_soon = False  # completion-flush scheduled (under lock)
+        self._ev_dropped_counter = None  # lazy overflow counter
         self._metrics_collector = None  # set by _observability_loop
         self._io.spawn(self._observability_loop())
         # live introspection: loop-lag health probe on the IO loop, and
@@ -491,14 +499,26 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             # correlate this driver's tasks with its job submission id
             ev["submission_id"] = sub
         ev.update(fields)
+        dropped = 0
         with self._task_events_lock:
             self._task_events.append(ev)
             if len(self._task_events) > config.task_events_buffer_size:
-                del self._task_events[:len(self._task_events) // 2]
+                dropped = len(self._task_events) // 2
+                del self._task_events[:dropped]
             schedule = (state in ("FINISHED", "FAILED")
                         and not self._flush_soon and not self._shutdown)
             if schedule:
                 self._flush_soon = True
+        if dropped:
+            # overflow is deliberate (events must never backpressure the
+            # submit hot path) but no longer silent:
+            # ray_tpu_task_events_dropped_total counts the loss
+            if self._ev_dropped_counter is None:
+                from ray_tpu._private.metrics import \
+                    task_events_dropped_counter
+
+                self._ev_dropped_counter = task_events_dropped_counter()
+            self._ev_dropped_counter.inc(dropped)
         if schedule:
             # completion events flush on a short coalescing delay instead
             # of waiting out the periodic interval: a snapshot taken right
@@ -784,8 +804,10 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                     self._warm_returned += 1
                     self._spawn(self._return_pooled(lease))
             # leases momentarily idle inside a class (between a reply and
-            # its pump) are fair game too once the pool is exhausted
-            for state in self._sched.values():
+            # its pump) are fair game too once the pool is exhausted.
+            # list(): caller threads insert new classes concurrently
+            # (_sched_state via staged submission)
+            for state in list(self._sched.values()):
                 for lease in list(state.leases):
                     if covered():
                         return
@@ -968,6 +990,15 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             return {"pending": True}
         return {"unknown": True}
 
+    async def rpc_fetch_objects(self, oids: List[str], wait: float = 0.0):
+        """Vectorized owner-side resolution: one frame resolves a whole
+        batch of this owner's objects (concurrent long-polls share the
+        wall-clock wait).  Per-oid results keyed by oid, each shaped
+        exactly like a fetch_object reply."""
+        results = await asyncio.gather(
+            *[self.rpc_fetch_object(oid, wait=wait) for oid in oids])
+        return {"results": dict(zip(oids, results))}
+
     async def rpc_task_ack(self, task_id: str):
         self._pending_acks.pop(task_id, None)
 
@@ -1106,6 +1137,12 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
         for _round in range(_MAX_RECONSTRUCTION_ROUNDS):
             plasma_fetch: List[Tuple[int, ObjectRef, Tuple[str, int]]] = []
             carry: List[Tuple[int, ObjectRef]] = []  # raced-clear retries
+            # borrowed refs whose location the owner must resolve,
+            # grouped so each owner gets ONE fetch_objects frame per
+            # wait round instead of one serial RPC per ref (10k small
+            # refs -> O(owners) round trips, not O(refs))
+            by_owner: Dict[Tuple[str, int],
+                           List[Tuple[int, ObjectRef]]] = {}
             for i, ref in pending:
                 oid = ref.oid
                 if self.memory.known(oid):
@@ -1140,16 +1177,19 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                     node = ref.node_addr if _round == 0 else None
                     if node is None and ref.owner_addr is not None \
                             and tuple(ref.owner_addr) != self.address:
-                        node = self._resolve_via_owner(ref, deadline)
-                        if node is None:
-                            # the resolver stored the inline value in the
-                            # MEMORY STORE; revisit next round to read it
-                            # into out (the memory.known branch)
-                            carry.append((i, ref))
-                            continue
+                        by_owner.setdefault(
+                            tuple(ref.owner_addr), []).append((i, ref))
+                        continue
                     if node is None:
                         node = self._locations.get(oid, self.agent_addr)
                     plasma_fetch.append((i, ref, node))
+            for owner, items in by_owner.items():
+                resolved_carry, resolved_plasma = \
+                    self._resolve_owner_batch(owner, items, deadline)
+                # inline values landed in the MEMORY STORE; revisit next
+                # round to read them into out (the memory.known branch)
+                carry.extend(resolved_carry)
+                plasma_fetch.extend(resolved_plasma)
             if not plasma_fetch:
                 if not carry:
                     self._reconstruction_outcome(reconstructed, ok=True)
@@ -1182,36 +1222,63 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             f"gave up reconstructing after {_MAX_RECONSTRUCTION_ROUNDS} "
             f"rounds; unrecoverable objects: {self._lost_detail(lost_refs)}")
 
-    def _resolve_via_owner(self, ref: ObjectRef, deadline) -> Optional[Tuple[str, int]]:
-        """Ask the owner where the object lives; may inline the value.
-
-        Returns a node address for the plasma path, or None if the value
-        was resolved inline (stored into memory store under the oid).
-        """
-        owner = tuple(ref.owner_addr)
-        while True:
+    def _resolve_owner_batch(self, owner: Tuple[str, int],
+                             items: List[Tuple[int, ObjectRef]], deadline
+                             ) -> Tuple[List[Tuple[int, ObjectRef]],
+                                        List[Tuple[int, ObjectRef,
+                                                   Tuple[str, int]]]]:
+        """Resolve a group of refs against their common owner: one
+        fetch_objects frame per long-poll round carries EVERY still-
+        pending oid (round-5 verdict: resolving many small borrowed refs
+        did one RPC round per ref).  Returns (carry, plasma): carry refs
+        resolved inline into the memory store (read next round), plasma
+        refs with the node address to pull from."""
+        pending = items
+        carry: List[Tuple[int, ObjectRef]] = []
+        plasma: List[Tuple[int, ObjectRef, Tuple[str, int]]] = []
+        while pending:
             remaining = None if deadline is None else deadline - time.monotonic()
             if remaining is not None and remaining <= 0:
-                raise GetTimeoutError(f"timed out resolving {ref.oid[:16]}")
+                raise GetTimeoutError(
+                    f"timed out resolving {pending[0][1].oid[:16]} "
+                    f"(+{len(pending) - 1} more)")
             wait = 10.0 if remaining is None else min(10.0, remaining)
             try:
-                r = self._io.run(self._afetch_from_owner(owner, ref.oid, wait),
-                                 timeout=wait + 30.0)
+                results = self._io.run(
+                    self._afetch_many_from_owner(
+                        owner, [ref.oid for _i, ref in pending], wait),
+                    timeout=wait + 30.0)
             except ConnectionLost:
                 raise ObjectLostError(
-                    f"owner of {ref.oid[:16]} at {owner} is unreachable")
-            if r.get("pending"):
-                continue
-            if r.get("freed"):
-                raise ObjectFreedError(f"object {ref.oid[:16]} was freed by its owner")
-            if r.get("unknown"):
-                raise ObjectLostError(f"owner does not know object {ref.oid[:16]}")
-            if "error" in r:
-                raise cloudpickle.loads(r["error"])
-            if "inline" in r:
-                self.memory.set_raw(ref.oid, r["inline"])
-                return None
-            return (r["plasma"][0], r["plasma"][1])
+                    f"owner of {pending[0][1].oid[:16]} at {owner} "
+                    f"is unreachable")
+            nxt: List[Tuple[int, ObjectRef]] = []
+            for i, ref in pending:
+                r = results.get(ref.oid) or {"unknown": True}
+                if r.get("pending"):
+                    nxt.append((i, ref))
+                elif r.get("freed"):
+                    raise ObjectFreedError(
+                        f"object {ref.oid[:16]} was freed by its owner")
+                elif r.get("unknown"):
+                    raise ObjectLostError(
+                        f"owner does not know object {ref.oid[:16]}")
+                elif "error" in r:
+                    raise cloudpickle.loads(r["error"])
+                elif "inline" in r:
+                    self.memory.set_raw(ref.oid, r["inline"])
+                    carry.append((i, ref))
+                else:
+                    plasma.append((i, ref, (r["plasma"][0], r["plasma"][1])))
+            pending = nxt
+        return carry, plasma
+
+    async def _afetch_many_from_owner(self, owner, oids: List[str],
+                                      wait: float) -> Dict[str, Any]:
+        c = await self._aclient_worker(owner)
+        r = await c.call("fetch_objects", oids=oids, wait=wait,
+                         timeout=wait + 20.0)
+        return r.get("results") or {}
 
     async def _afetch_from_owner(self, owner, oid: str, wait: float,
                                  lost_at=None):
@@ -1242,28 +1309,33 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
         """Localize + read plasma objects; fills `out` for successes and
         returns [(i, ref, node, err)] for objects that could not be
         localized (lost primaries — reconstruction candidates)."""
-        # 1. make everything local (pulls run concurrently on the IO loop)
+        # 1. make everything local: ONE ensure_local_batch frame to our
+        # agent carries every (oid, source) pair — the agent pulls them
+        # concurrently (deduped against in-flight pulls) and replies
+        # per-oid, so localizing N objects costs one RPC round, not N
         async def _ensure_all():
-            import asyncio
-            coros = []
-            for i, ref, node in items:
-                async def one(oid=ref.oid, node=node):
-                    return await self.agent.aio.call(
-                        "ensure_local", oid=oid, src=list(node) if node else None,
-                        timeout=config.rpc_call_timeout_s)
-                coros.append(one())
-            return await asyncio.gather(*coros, return_exceptions=True)
+            r = await self.agent.aio.call(
+                "ensure_local_batch",
+                items=[[ref.oid, list(node) if node else None]
+                       for _i, ref, node in items],
+                timeout=config.rpc_call_timeout_s)
+            return r.get("results") or []
 
-        replies = self._io.run(_ensure_all(), timeout=config.rpc_call_timeout_s + 30)
+        try:
+            replies = self._io.run(_ensure_all(),
+                                   timeout=config.rpc_call_timeout_s + 30)
+        except Exception as e:
+            # transient transport trouble with our own agent is NOT
+            # evidence the primaries are lost — don't trigger duplicate
+            # re-executions for it
+            raise ObjectLostError(
+                f"could not localize {items[0][1].oid[:16]} "
+                f"(+{len(items) - 1} more): {e}") from e
         failures: List[Tuple[int, ObjectRef, Tuple[str, int], str]] = []
         localized = []
-        for (i, ref, node), r in zip(items, replies):
-            if isinstance(r, Exception):
-                # transient transport trouble with our own agent is NOT
-                # evidence the primary is lost — don't trigger a duplicate
-                # re-execution for it
-                raise ObjectLostError(
-                    f"could not localize {ref.oid[:16]}: {r}") from r
+        for (i, ref, node), r in zip(
+                items, list(replies) + [{"ok": False, "error": "no reply"}]
+                * max(0, len(items) - len(replies))):
             if not r.get("ok"):
                 failures.append((i, ref, node, str(r.get("error"))))
             else:
@@ -1573,34 +1645,40 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
         if any(a.object_id is not None for a in spec.args):
             self._spawn(self._submit(task))
         else:
-            # no ref args: nothing to resolve — skip the coroutine
-            # machinery (run_coroutine_threadsafe allocates a Task per
-            # call; a coalesced post is ~5x cheaper on the hot path)
-            try:
-                self._post_to_loop(self._enqueue_ready, task)
-            except RuntimeError:
-                pass  # loop shut down
+            # no ref args: nothing to resolve — stage straight into the
+            # class's partitioned queue.  The caller thread takes only
+            # this class's lock and pays ONE loop wakeup per burst (the
+            # pump_queued edge); the coalesced pump forms real
+            # push_tasks batches out of whatever accumulated.
+            self._stage_ready(task)
         if span is not None:
             span.end()
         return refs
 
     def _sched_state(self, key: tuple) -> _SchedState:
+        # called from caller threads too (staged submission): setdefault
+        # keeps concurrent first-submissions of one class to one state
         state = self._sched.get(key)
         if state is None:
-            state = self._sched[key] = _SchedState(key)
+            state = self._sched.setdefault(key, _SchedState(key))
         return state
 
-    def _enqueue_ready(self, task: _TaskState) -> None:
+    def _stage_ready(self, task: _TaskState) -> None:
         state = self._sched_state(task.sched_key)
-        state.pending.append(task)
-        if not state.pump_queued:
-            # coalesce: every _enqueue_ready already queued on the loop
-            # runs (appending) before this callback pumps them together
+        with state.lock:
+            state.staged.append(task)
+            if state.pump_queued:
+                return
             state.pump_queued = True
-            self._loop().call_soon(self._coalesced_pump, state)
+        try:
+            self._loop().call_soon_threadsafe(self._coalesced_pump, state)
+        except RuntimeError:
+            with state.lock:
+                state.pump_queued = False  # loop shut down
 
     def _coalesced_pump(self, state: _SchedState) -> None:
-        state.pump_queued = False
+        with state.lock:
+            state.pump_queued = False
         self._pump(state)
 
     async def _submit(self, task: _TaskState):
@@ -1671,8 +1749,18 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             task.cancelled = True
             self._fail_task(task, err)
             return
-        # 1. still pending owner-side (never pushed): fail it locally
-        for state in self._sched.values():
+        # 1. still pending owner-side (never pushed): fail it locally.
+        # list(): caller threads insert new classes concurrently
+        for state in list(self._sched.values()):
+            # staged = submitted but not yet drained by a pump pass
+            with state.lock:
+                staged_hit = next((t for t in state.staged
+                                   if t.spec.task_id == task_id), None)
+                if staged_hit is not None:
+                    state.staged.remove(staged_hit)
+            if staged_hit is not None:
+                self._fail_task(staged_hit, err)
+                return
             for task in list(state.pending):
                 if task.spec.task_id == task_id:
                     state.pending.remove(task)
@@ -1685,7 +1773,7 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                         await self._cancel_on_worker(
                             task, lease.addr, force)
                         return
-        for astate in self._actors.values():
+        for astate in list(self._actors.values()):
             for task in list(astate.pending):
                 if task.spec.task_id == task_id:
                     astate.pending.remove(task)
@@ -1842,15 +1930,28 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
         return best
 
     def _pump(self, state: _SchedState):
-        # hand pending tasks to leases, shallowest pipeline first, at the
-        # depth the service-time curve allows; adopt warm-pool leases
-        # before breaking — a pooled worker beats both a deeper pipeline
-        # and a fresh lease request
+        # drain the cross-thread staged queue first: one pass moves a
+        # whole submission burst into pending (partitioned handoff —
+        # only this class's lock, never a process-global one)
+        if state.staged:
+            with state.lock:
+                state.pending.extend(state.staged)
+                state.staged.clear()
+        # hand pending tasks to leases at the depth the service-time
+        # curve allows; adopt warm-pool leases before breaking — a
+        # pooled worker beats both a deeper pipeline and a fresh lease
+        # request
         live = [l for l in state.leases if not l.dead]
         depth = state.stats.depth()
-        # group this tick's assignments per lease: N tasks to one worker
-        # ride ONE push_tasks frame instead of N push RPCs (reference:
-        # direct task submission batches over the lease connection)
+        # group this tick's assignments per lease, filling each chosen
+        # lease's pipeline with a CHUNK of consecutive tasks: N tasks to
+        # one worker ride ONE push_tasks frame instead of N push RPCs.
+        # Assigning one task at a time to the min-inflight lease (the
+        # old policy) fragmented a burst into batches of 1-2 spread
+        # round-robin across leases — frames, not payload bytes, are
+        # what cap small-task throughput, so the fragmentation was the
+        # tasks/s ceiling (round-6 profile: 340 single-task frames for
+        # a 1000-task burst).
         batches: Dict[int, Tuple[_Lease, List[_TaskState]]] = {}
         deferred: List[_TaskState] = []
         now = time.monotonic()
@@ -1864,13 +1965,13 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                     break  # every lease at depth, nothing warm to adopt
                 live.append(adopted)
                 continue
-            task = state.pending.popleft()
+            head = state.pending[0]
             # a lease on the node already holding the task's argument
             # bytes beats the shallowest pipeline: the task skips the
             # transfer entirely (cluster-level locality routing decided
             # node choice; this is its per-task dispatch counterpart)
             lease = None
-            pref = self._locality_pref_addr(task.spec)
+            pref = self._locality_pref_addr(head.spec)
             if pref is not None:
                 for cand in candidates:
                     if tuple(cand.agent_addr) == pref:
@@ -1888,17 +1989,36 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                     # flight, within the deadline — bounded, so a
                     # saturated holder can only delay it, never strand
                     # it
-                    first = task.defer_deadline == 0.0
+                    state.pending.popleft()
+                    first = head.defer_deadline == 0.0
                     if first:
-                        task.defer_deadline = now + _LOCALITY_DEFER_S
-                    if now < task.defer_deadline \
+                        head.defer_deadline = now + _LOCALITY_DEFER_S
+                    if now < head.defer_deadline \
                             and (first or state.inflight_requests > 0):
-                        deferred.append(task)
+                        deferred.append(head)
                         continue
+                    # deferral bound passed: dispatch off-holder rather
+                    # than strand the task
+                    lease = min(candidates, key=lambda l: len(l.inflight))
+                    lease.inflight.append(head)
+                    batches.setdefault(id(lease), (lease, []))[1].append(head)
+                    continue
             if lease is None:
                 lease = min(candidates, key=lambda l: len(l.inflight))
-            lease.inflight.append(task)
-            batches.setdefault(id(lease), (lease, []))[1].append(task)
+            # fill the chosen lease's pipeline with consecutive
+            # compatible tasks — a task whose locality pref names a
+            # DIFFERENT node breaks the chunk and gets its own pass
+            chunk = batches.setdefault(id(lease), (lease, []))[1]
+            lease_addr = tuple(lease.agent_addr)
+            while len(lease.inflight) < depth and state.pending:
+                nxt = state.pending[0]
+                npref = (pref if nxt is head
+                         else self._locality_pref_addr(nxt.spec))
+                if npref is not None and lease_addr != npref:
+                    break
+                state.pending.popleft()
+                lease.inflight.append(nxt)
+                chunk.append(nxt)
         if deferred:
             state.pending.extendleft(reversed(deferred))
             if not state.defer_timer:
@@ -1914,6 +2034,9 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
 
                 self._loop().call_later(max(0.0, wake - now) + 0.01, _expire)
         for lease, tasks in batches.values():
+            if not tasks:
+                continue
+            self._observe_batch_size(len(tasks))
             if len(tasks) == 1:
                 self._spawn(self._push(state, lease, tasks[0]))
             else:
@@ -1934,17 +2057,50 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                 if not lease.inflight and not lease.dead:
                     self._park_lease(state, lease)
             return
-        # request more leases if there is unmet demand; each request
-        # carries a DISTINCT pending task's spec (not head-of-queue N
-        # times) so their locality hints route leases to each task's
-        # holder instead of piling every lease on the first task's node
-        deficit = len(state.pending) - state.inflight_requests
-        capacity = _MAX_LEASES_PER_CLASS - len(state.leases) - state.inflight_requests
-        for _ in range(max(0, min(deficit, capacity))):
-            state.inflight_requests += 1
-            spec = state.pending[state.req_rr % len(state.pending)].spec
-            state.req_rr += 1
-            self._spawn(self._request_lease(state, spec))
+        # request more leases if there is unmet demand.  The ask is
+        # sized to the pipeline capacity still uncovered — pending /
+        # depth workers — not to raw pending count (the old policy
+        # over-requested 16 leases for a sub-ms burst one worker could
+        # drain, churning worker spawns + queued-request cancels).
+        # every live lease is already pipeline-saturated here (the
+        # assignment loop only leaves pending tasks when no lease is
+        # below depth), so the uncovered demand is pending alone —
+        # subtracting live leases again would starve small bursts that
+        # spill just past one lease's depth
+        need = -(-len(state.pending) // max(1, depth))  # ceil
+        deficit = need - state.inflight_requests
+        capacity = (_MAX_LEASES_PER_CLASS - len(state.leases)
+                    - state.inflight_requests)
+        want = max(0, min(deficit, capacity,
+                          int(config.lease_request_batch_max)))
+        if want <= 0:
+            return
+        head_spec = state.pending[0].spec
+        if deferred or head_spec.placement_group_id:
+            # locality-deferred tasks (or bundle-targeted specs) need
+            # each request to carry a DISTINCT pending task's spec so
+            # hints route leases to each task's holder — keep the
+            # per-spec single-request path for them
+            for _ in range(want):
+                state.inflight_requests += 1
+                spec = state.pending[state.req_rr % len(state.pending)].spec
+                state.req_rr += 1
+                self._spawn(self._request_lease(state, spec))
+        else:
+            # homogeneous demand: ONE request_leases frame asks the
+            # agent for every missing lease at once — a 2k-task burst
+            # costs O(1) lease RPC rounds, not O(missing leases)
+            state.inflight_requests += want
+            self._spawn(self._request_leases(state, head_spec, want))
+
+    _batch_hist = None
+
+    def _observe_batch_size(self, n: int) -> None:
+        if self._batch_hist is None:
+            from ray_tpu._private.metrics import dispatch_batch_size_histogram
+
+            self._batch_hist = dispatch_batch_size_histogram()
+        self._batch_hist.observe(n)
 
     async def _cancel_lease_request(self, rid: str, addr: Tuple[str, int]):
         try:
@@ -2028,6 +2184,67 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             if rid:
                 state.request_agents.pop(rid, None)
             state.inflight_requests -= 1
+            self._pump(state)
+
+    async def _request_leases(self, state: _SchedState, spec: TaskSpec,
+                              count: int):
+        """Batched lease acquisition: ONE request_leases frame asks an
+        agent for up to `count` workers of this spec's shape; the agent
+        grants what fits now in one reply (node_agent.rpc_request_leases).
+        A partial grant returns immediately — the post-reply pump
+        recomputes the deficit and re-asks, which converges in at most
+        one extra frame while never camping on a saturated agent's FIFO
+        with a multi-lease request."""
+        rid = ""
+        try:
+            state.req_counter += 1
+            rid = f"{self.worker_id[:12]}-{state.req_counter}"
+            agent_addr = self.agent_addr
+            for _hop in range(8):
+                state.request_agents[rid] = agent_addr
+                try:
+                    c = await self._aclient_agent(agent_addr)
+                    reply = await c.call(
+                        "request_leases", spec=spec.to_wire(), count=count,
+                        req_id=rid,
+                        timeout=config.worker_lease_timeout_ms / 1000.0 + 10.0)
+                except (ConnectionLost, RpcError):
+                    if agent_addr == self.agent_addr:
+                        raise
+                    agent_addr = self.agent_addr  # spillback target died
+                    continue
+                if "spillback" in reply:
+                    agent_addr = tuple(reply["spillback"]["addr"])
+                    continue
+                grants = reply.get("granted_list") or ()
+                for g in grants:
+                    state.leases.append(_Lease(
+                        g["lease_id"], g["worker_id"],
+                        (g["addr"][0], g["addr"][1]), agent_addr,
+                        tpu_chips=g.get("tpu_chips"),
+                        pool_key=self._pool_key_of(state.key),
+                        resources=dict(spec.resources)))
+                if grants:
+                    return
+                if reply.get("error") == "infeasible":
+                    err = SchedulingError(reply.get("error_str", "infeasible"))
+                    while state.pending:
+                        self._fail_task(state.pending.popleft(), err)
+                    return
+                if reply.get("error") == "runtime env setup failed":
+                    err = RuntimeEnvSetupError(
+                        reply.get("error_str", "runtime env setup failed"))
+                    while state.pending:
+                        self._fail_task(state.pending.popleft(), err)
+                    return
+                if reply.get("error") == "canceled":
+                    return  # we canceled it: demand drained
+                if not state.pending:
+                    return  # lease timeout with no demand left
+        finally:
+            if rid:
+                state.request_agents.pop(rid, None)
+            state.inflight_requests -= count
             self._pump(state)
 
     async def _request_pg_lease(self, state: _SchedState, spec: TaskSpec):
@@ -2735,19 +2952,22 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
         return {"done": len(specs)}
 
     def _queue_batch_result(self, conn, tid: str, reply: Dict[str, Any]):
-        """Micro-batch per-task results: flush when 32 are buffered or
-        5ms after the first, whichever comes first.  Trivial-task bursts
-        coalesce many results per frame (frames, not payload bytes, are
-        what cap small-task throughput); the 5ms ceiling is noise next
-        to any non-trivial task's runtime."""
+        """Micro-batch per-task results: flush when
+        dispatch_result_batch_max are buffered or
+        dispatch_result_flush_ms after the first, whichever comes first.
+        Trivial-task bursts coalesce many results per frame (frames, not
+        payload bytes, are what cap small-task throughput); the ms
+        ceiling is noise next to any non-trivial task's runtime."""
         key = id(conn)
         ent = self._result_bufs.get(key)
         if ent is None:
             self._result_bufs[key] = (conn, [{"tid": tid, "reply": reply}])
-            self._loop().call_later(0.005, self._flush_batch_results, key)
+            self._loop().call_later(
+                config.dispatch_result_flush_ms / 1000.0,
+                self._flush_batch_results, key)
         else:
             ent[1].append({"tid": tid, "reply": reply})
-            if len(ent[1]) >= 32:
+            if len(ent[1]) >= int(config.dispatch_result_batch_max):
                 self._flush_batch_results(key)
 
     def _flush_batch_results(self, key: int) -> None:

@@ -25,6 +25,16 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+# jax >= 0.6 exposes shard_map at top level with check_vma=;
+# older releases ship jax.experimental.shard_map with check_rep=
+try:
+    _shard_map = jax.shard_map
+    _CHECK_KW = "check_vma"
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
 _NEG_INF = -1e30
 
 
@@ -107,8 +117,8 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp", causal: bool = True,
     spec = P(batch_axes, axis_name, head_axis, None)
     body = partial(_ring_body, axis_name=axis_name, n_blocks=n_sp,
                    block_len=block_len, causal=causal)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
+    fn = _shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                    out_specs=spec, **{_CHECK_KW: False})
     return fn(q, k, v)
 
 

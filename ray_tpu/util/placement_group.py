@@ -25,9 +25,15 @@ class PlacementGroupError(RayError):
 
 
 class PlacementGroup:
-    def __init__(self, pg_id: str, bundles: Optional[List[Dict[str, float]]] = None):
+    def __init__(self, pg_id: str, bundles: Optional[List[Dict[str, float]]] = None,
+                 info: Optional[Dict] = None):
         self.id = pg_id
         self._bundles = bundles or []
+        # create-reply snapshot: when the head's inline scheduling pass
+        # already committed the group, ready()/wait() answer from this
+        # with no extra round trip (PG churn is a benchmarked hot path)
+        self._created_info = info if (info or {}).get("state") == "CREATED" \
+            else None
 
     @property
     def bundle_specs(self) -> List[Dict[str, float]]:
@@ -47,6 +53,15 @@ class PlacementGroup:
         Reference exposes ready() as an ObjectRef; blocking with a timeout
         is the ergonomic equivalent for this API.
         """
+        if self._created_info is not None:
+            # one-shot: the create reply proved CREATED for the first
+            # ready()/wait(); later calls must re-poll — the group may
+            # have gone back to PENDING on a node death or been removed,
+            # and a cached success would lie about it.  (A bundle lost
+            # in the tiny create→first-wait window is still recovered by
+            # the lease path's "bundle not reserved" refresh-and-retry.)
+            self._created_info = None
+            return self
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             remaining = None if deadline is None else deadline - time.monotonic()
@@ -87,7 +102,8 @@ def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
     reply = w.head.call("create_placement_group", bundles=list(bundles),
                         strategy=strategy, name=name,
                         pg_id=PlacementGroupID.from_random().hex())
-    return PlacementGroup(reply["pg_id"], list(bundles))
+    return PlacementGroup(reply["pg_id"], list(bundles),
+                          info=reply.get("info"))
 
 
 def placement_group_table() -> List[Dict]:

@@ -1,0 +1,368 @@
+"""Batched, owner-partitioned task submission (ISSUE 8).
+
+Covers the four tentpole layers: burst-history-independent async
+dispatch, multi-task control frames (push_tasks / request_leases /
+ensure_local_batch / fetch_objects / reserve_bundles), the partitioned
+owner pump forming real batches, and the sharded head object
+directory.  Frame-shape assertions count frames via a counting wrapper
+around rpc._pack in THIS process (the driver side of every exchange);
+wall-clock assertions follow the slow-box protocol (best-of repeats,
+ratio thresholds only).
+"""
+
+import asyncio
+import os
+import time
+from contextlib import contextmanager
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import rpc as rpcmod
+from ray_tpu._private.object_directory import (DeltaReporter,
+                                               DirectoryMirror,
+                                               ShardedObjectDirectory)
+
+
+@contextmanager
+def _frame_counter():
+    """Count control frames sent by this process, keyed (kind, method)."""
+    counts = {}
+    orig = rpcmod._pack
+
+    def counting(kind, req_id, method, payload):
+        counts[(kind, method)] = counts.get((kind, method), 0) + 1
+        return orig(kind, req_id, method, payload)
+
+    rpcmod._pack = counting
+    try:
+        yield counts
+    finally:
+        rpcmod._pack = orig
+
+
+def _frames(counts, method):
+    return sum(n for (_k, m), n in counts.items() if m == method)
+
+
+# ------------------------------------------------- sharded directory units
+
+
+class TestShardedDirectory:
+    def test_shard_index_is_process_independent(self):
+        """Head and agents live in different processes: shard assignment
+        must not use Python's salted hash() (a mismatch silently sends
+        every mirror lookup to the wrong bucket)."""
+        from ray_tpu._private.object_directory import _shard_index
+
+        import zlib
+        assert _shard_index("deadbeef" * 3, 16) == \
+            zlib.crc32(b"deadbeef" * 3) % 16  # crc32: stable across runs
+
+    def test_delta_apply_and_locations(self):
+        d = ShardedObjectDirectory(num_shards=4, epoch="e1")
+        d.apply_delta("n1", [["a" * 8, 100], ["b" * 8, 200]], [])
+        d.apply_delta("n2", [["a" * 8, 100]], [])
+        assert d.locations("a" * 8) == {"n1": 100, "n2": 100}
+        assert d.locations("b" * 8) == {"n1": 200}
+        d.apply_delta("n1", [], ["a" * 8])
+        assert d.locations("a" * 8) == {"n2": 100}
+
+    def test_versions_move_only_on_touched_shards(self):
+        d = ShardedObjectDirectory(num_shards=8, epoch="e1")
+        before = d.versions()
+        d.apply_delta("n1", [["x" * 8, 50]], [])
+        after = d.versions()
+        assert sum(a != b for a, b in zip(before, after)) == 1
+
+    def test_updates_since_is_incremental(self):
+        d = ShardedObjectDirectory(num_shards=4, epoch="e1")
+        d.apply_delta("n1", [["x" * 8, 50]], [])
+        full = d.updates_since(None)
+        assert any(u["holders"].get("x" * 8) for u in full.values())
+        seen = d.versions()
+        assert d.updates_since(seen) == {}
+        d.apply_delta("n1", [["y" * 8, 60]], [])
+        inc = d.updates_since(seen)
+        assert len(inc) == 1
+        (payload,) = inc.values()
+        assert payload["holders"]["y" * 8] == {"n1": 60}
+
+    def test_drop_node_removes_every_holder_entry(self):
+        d = ShardedObjectDirectory(num_shards=4, epoch="e1")
+        d.apply_delta("n1", [[f"oid{i}", 10] for i in range(20)], [])
+        d.apply_delta("n2", [["oid3", 10]], [])
+        d.drop_node("n1")
+        assert d.node_entries("n1") == {}
+        assert d.locations("oid0") == {}
+        assert d.locations("oid3") == {"n2": 10}
+
+    def test_full_resend_drops_stale_entries(self):
+        d = ShardedObjectDirectory(num_shards=4, epoch="e1")
+        d.apply_delta("n1", [["old", 10], ["keep", 20]], [])
+        d.apply_delta("n1", [["keep", 20], ["new", 30]], [], full=True)
+        assert d.node_entries("n1") == {"keep": 20, "new": 30}
+
+    def test_mirror_applies_versioned_updates(self):
+        d = ShardedObjectDirectory(num_shards=4, epoch="e1")
+        m = DirectoryMirror(num_shards=4)
+        d.apply_delta("n1", [["obj", 42]], [])
+        m.apply_updates(d.updates_since(m.seen_versions()))
+        assert m.holders("obj") == {"n1": 42}
+        # no churn -> nothing to ship
+        assert d.updates_since(m.seen_versions()) == {}
+        d.apply_delta("n1", [], ["obj"])
+        m.apply_updates(d.updates_since(m.seen_versions()))
+        assert m.holders("obj") == {}
+
+    def test_delta_reporter_epoch_handshake(self):
+        r = DeltaReporter()
+        d1 = r.build([["a", 1], ["b", 2]], "epoch1")
+        assert d1["full"] and sorted(oid for oid, _ in d1["add"]) == ["a", "b"]
+        r.ack()
+        # steady state: no churn -> empty delta
+        d2 = r.build([["a", 1], ["b", 2]], "epoch1")
+        assert not d2["full"] and d2["add"] == [] and d2["remove"] == []
+        r.ack()
+        # removal flows as a remove entry
+        d3 = r.build([["a", 1]], "epoch1")
+        assert d3["remove"] == ["b"]
+        r.ack()
+        # head restarted (new epoch): everything re-sends
+        d4 = r.build([["a", 1]], "epoch2")
+        assert d4["full"] and d4["add"] == [["a", 1]]
+
+    def test_unacked_delta_is_rebuilt(self):
+        """A heartbeat that died in flight must not lose its delta."""
+        r = DeltaReporter()
+        r.build([["a", 1]], "e")
+        r.ack()
+        d = r.build([["a", 1], ["b", 2]], "e")  # not acked (call failed)
+        assert d["add"] == [["b", 2]]
+        d = r.build([["a", 1], ["b", 2]], "e")
+        assert d["add"] == [["b", 2]]  # still pending
+
+
+# ------------------------------------------------- batched control frames
+
+
+def test_async_burst_uses_batched_frames(local_cluster):
+    """A 300-task async burst must cost O(batches) push frames and O(1)
+    lease-request frames — not one frame per task (the round-6 profile
+    showed 340 single-task frames per 1000 tasks before batching)."""
+
+    @ray_tpu.remote
+    def e():
+        return 1
+
+    ray_tpu.get([e.remote() for _ in range(50)], timeout=60)  # warm
+    n = 300
+    with _frame_counter() as counts:
+        out = ray_tpu.get([e.remote() for _ in range(n)], timeout=120)
+    assert out == [1] * n
+    pushes = _frames(counts, "push_tasks") + _frames(counts, "push_task")
+    assert pushes <= n // 3, (
+        f"pump fragmented: {pushes} push frames for {n} tasks "
+        f"({dict(counts)})")
+    # batched request_leases frames cover the whole deficit: each
+    # partial grant (workers still spawning) triggers one re-ask, so
+    # the count tracks grant cycles — O(node CPUs), never O(tasks)
+    assert _frames(counts, "request_lease") == 0
+    assert _frames(counts, "request_leases") <= 12
+
+
+def test_batched_get_localizes_in_one_frame(local_cluster):
+    """get() over many plasma-stored objects sends ONE
+    ensure_local_batch frame to the agent, not one ensure_local per
+    ref (round-5 verdict: vectorized driver get)."""
+    import numpy as np
+
+    refs = [ray_tpu.put(np.zeros(50_000)) for _ in range(20)]  # >100KB each
+    with _frame_counter() as counts:
+        vals = ray_tpu.get(refs, timeout=60)
+    assert all(v.shape == (50_000,) for v in vals)
+    assert _frames(counts, "ensure_local") == 0
+    assert _frames(counts, "ensure_local_batch") == 1, dict(counts)
+
+
+def test_worker_materializes_many_borrowed_refs(local_cluster):
+    """A task taking many driver-owned refs resolves them through the
+    batched fetch_objects path (owner side) and still sees every
+    value."""
+
+    @ray_tpu.remote
+    def total(xs):
+        return sum(ray_tpu.get(list(xs), timeout=60))
+
+    refs = [ray_tpu.put(i) for i in range(40)]
+    assert ray_tpu.get(total.remote(refs), timeout=60) == sum(range(40))
+
+
+def test_burst_then_async_is_history_independent(local_cluster):
+    """Regression for the round-5 top finding: a blocking sync burst
+    must not depress the async rate that follows.  Best-of repeats on
+    both sides (slow-box protocol); post-burst retries stop early once
+    the bar is met, so a recovered-but-noisy box can't flake this."""
+
+    @ray_tpu.remote
+    def e():
+        return 1
+
+    n = 300
+
+    def async_rate():
+        t0 = time.perf_counter()
+        ray_tpu.get([e.remote() for _ in range(n)], timeout=120)
+        return n / (time.perf_counter() - t0)
+
+    ray_tpu.get([e.remote() for _ in range(50)], timeout=60)  # warm
+    fresh = max(async_rate() for _ in range(2))
+    for _ in range(200):  # the history pollution
+        ray_tpu.get(e.remote(), timeout=60)
+    post = 0.0
+    for _ in range(3):
+        post = max(post, async_rate())
+        if post >= 0.75 * fresh:
+            break
+    assert post >= 0.75 * fresh, (
+        f"async collapsed after sync burst: fresh={fresh:.0f}/s "
+        f"post={post:.0f}/s")
+
+
+def test_cancel_inside_batch_frame(local_cluster):
+    """A cancelled task travelling inside a multi-task push_tasks frame
+    resolves as cancelled WITHOUT poisoning its batch siblings."""
+
+    @ray_tpu.remote(max_retries=0)
+    def step(x, delay):
+        if delay:
+            time.sleep(delay)
+        return x
+
+    from ray_tpu._private.errors import TaskCancelledError
+
+    # train the class sub-ms so the pump batches deep
+    ray_tpu.get([step.remote(i, 0) for i in range(30)], timeout=60)
+    # CPU:4 pins the class to ONE lease -> slow head + queued siblings
+    # ride one frame behind it
+    opts = step.options(resources={"CPU": 4})
+    ray_tpu.get(opts.remote(-1, 0), timeout=60)  # warm the 4-CPU class
+    slow = opts.remote(-2, 3.0)
+    quick = [opts.remote(i, 0) for i in range(8)]
+    victim = quick[3]
+    time.sleep(0.3)  # let the frame reach the worker, slow task running
+    ray_tpu.cancel(victim)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(victim, timeout=60)
+    rest = [r for i, r in enumerate(quick) if i != 3]
+    assert ray_tpu.get(rest, timeout=120) == [0, 1, 2, 4, 5, 6, 7]
+    assert ray_tpu.get(slow, timeout=60) == -2
+
+
+# ------------------------------------------------- chaos on the batch RPCs
+
+
+@pytest.fixture
+def chaos_rules():
+    """Install driver-process chaos rules; always disarm after."""
+    from ray_tpu._private import fault_injection
+
+    installed = []
+
+    def arm(rules):
+        installed.extend(rules)
+        fault_injection.install(rules, fault_injection.version + 1)
+
+    yield arm
+    fault_injection.install([], fault_injection.version + 1)
+
+
+def test_chaos_sever_on_push_tasks_requeues_batch(local_cluster,
+                                                  chaos_rules):
+    """rpc.send severing a push_tasks frame mid-burst: the owner maps
+    the connection loss to a lease death, requeues the unstarted tasks,
+    and the burst still completes on a replacement lease."""
+
+    @ray_tpu.remote
+    def e(x):
+        return x
+
+    ray_tpu.get([e.remote(i) for i in range(30)], timeout=60)  # warm
+    chaos_rules([{"site": "rpc.send", "action": "sever",
+                  "target": ":push_tasks", "count": 1, "p": 1.0}])
+    out = ray_tpu.get([e.remote(i) for i in range(200)], timeout=120)
+    assert out == list(range(200))
+    from ray_tpu._private import fault_injection
+
+    assert fault_injection.fired_counts(), "sever rule never fired"
+
+
+def test_chaos_delay_on_request_leases(local_cluster, chaos_rules):
+    """Delaying the batched lease frames must only slow the burst, never
+    wedge or shrink it."""
+
+    @ray_tpu.remote
+    def e(x):
+        return x
+
+    chaos_rules([{"site": "rpc.send", "action": "delay", "delay_s": 0.2,
+                  "target": ":request_leases", "count": 3, "p": 1.0}])
+    out = ray_tpu.get([e.remote(i) for i in range(150)], timeout=120)
+    assert out == list(range(150))
+
+
+# ------------------------------------------------- PG commit batching
+
+
+def test_pg_reserve_batches_per_node(tmp_path):
+    """A multi-bundle PG commits all of a node's bundles in ONE
+    reserve_bundles frame and returns them in ONE return_bundles frame."""
+    from ray_tpu._private.head import HeadService
+    from ray_tpu._private.node_agent import NodeAgent
+
+    async def main():
+        head = HeadService()
+        head_port = await head.start()
+        agent = NodeAgent(("127.0.0.1", head_port), str(tmp_path),
+                          {"CPU": 8}, capacity=1 << 20)
+        await agent.start()
+        reserve_frames = []
+        return_frames = []
+        orig_reserve = agent.rpc_reserve_bundles
+        orig_return = agent.rpc_return_bundles
+
+        async def counting_reserve(pg_id, items, wait_ms=0, _conn=None):
+            reserve_frames.append(len(items))
+            return await orig_reserve(pg_id, items, wait_ms=wait_ms,
+                                      _conn=_conn)
+
+        async def counting_return(pg_id, indices):
+            return_frames.append(len(indices))
+            return await orig_return(pg_id, indices)
+
+        agent.rpc_reserve_bundles = counting_reserve
+        agent.rpc_return_bundles = counting_return
+        try:
+            r = await head.rpc_create_placement_group(
+                bundles=[{"CPU": 1}] * 4, strategy="PACK", pg_id="aa" * 14)
+            assert r["info"]["state"] == "CREATED", r
+            assert reserve_frames == [4], reserve_frames
+            await head.rpc_remove_placement_group("aa" * 14)
+            assert return_frames == [4], return_frames
+        finally:
+            await agent.stop()
+            await head.stop()
+
+    asyncio.run(main())
+
+
+def test_pg_create_reply_carries_created_info(local_cluster):
+    """pg.wait() after an inline-committed create answers from the
+    create reply — zero get_placement_group round trips."""
+    from ray_tpu.util import placement_group, remove_placement_group
+
+    pg = placement_group([{"CPU": 1}])
+    with _frame_counter() as counts:
+        assert pg.wait(timeout=30)
+    assert _frames(counts, "get_placement_group") == 0, dict(counts)
+    remove_placement_group(pg)

@@ -260,7 +260,10 @@ class TestPrefetch:
 
 
 class TestDirectory:
-    def test_heartbeat_feeds_directory_and_cluster_view(self, tmp_path):
+    def test_heartbeat_delta_feeds_sharded_directory(self, tmp_path):
+        """Agents report object DELTAS; the head folds them into the
+        sharded directory, and other agents' mirrors converge via the
+        shard-versioned updates on heartbeat replies."""
         async def main():
             head, agents = await _boot(tmp_path)
             a, b = agents
@@ -271,14 +274,34 @@ class TestDirectory:
                 _seed_object(a, "oid-small", b"x" * 1024)
                 a._hb_wake.set()
                 for _ in range(100):
-                    if "oid-dir" in head.nodes[a.node_id].objects:
+                    if head.dir.locations("oid-dir"):
                         break
                     await asyncio.sleep(0.05)
-                assert head.nodes[a.node_id].objects["oid-dir"] == len(payload)
-                assert "oid-small" not in head.nodes[a.node_id].objects
+                assert head.dir.locations("oid-dir") == {
+                    a.node_id: len(payload)}
+                assert not head.dir.locations("oid-small")
+                assert head.dir.node_entries(a.node_id) == {
+                    "oid-dir": len(payload)}
                 view = head._cluster_view()
                 assert view[a.node_id]["xfer"] == a.xfer_port
-                assert "oid-dir" in view[a.node_id]["objects"]
+                # the PEER agent's mirror learns the holder too (its
+                # next heartbeat reply carries the changed shard)
+                b._hb_wake.set()
+                for _ in range(100):
+                    if b._dir_mirror.holders("oid-dir"):
+                        break
+                    await asyncio.sleep(0.05)
+                assert b._dir_mirror.holders("oid-dir") == {
+                    a.node_id: len(payload)}
+                # freeing the object flows a removal delta through
+                a.store.free(["oid-dir"])
+                a._hb_wake.set()
+                for _ in range(100):
+                    if not head.dir.locations("oid-dir"):
+                        break
+                    a._hb_wake.set()
+                    await asyncio.sleep(0.05)
+                assert not head.dir.locations("oid-dir")
             finally:
                 await _down(head, agents)
         _run(main())

@@ -329,7 +329,9 @@ def test_metric_names_documented_in_readme(cluster):
     for fn in (m.object_transfer_metrics, m.dag_metrics,
                m.serve_request_latency_histogram, m.loop_lag_gauge,
                m.dispatch_pump_depth_gauge, m.dag_channel_occupancy_gauge,
-               m.serve_proxy_inflight_gauge, m.fault_tolerance_metrics):
+               m.serve_proxy_inflight_gauge, m.fault_tolerance_metrics,
+               m.task_events_dropped_counter,
+               m.dispatch_batch_size_histogram):
         fn()
     with m.default_registry._lock:
         names |= set(m.default_registry._metrics)
