@@ -290,6 +290,53 @@ def bench_profile_overhead(ray_tpu, n=1200, pairs=2):
         "profile_overhead_pct": round(100.0 * (off - on) / off, 2),
     }
 
+def bench_memory_scan_overhead(ray_tpu, n=2500, pairs=2, live_objects=10_000):
+    """Memory-accounting cost phase: async task throughput while the
+    head's periodic leak scan (running at its DEFAULT cadence) joins a
+    10k-entry driver reference table every interval, vs the same scan
+    over an emptied table.  The differential is what `rtpu memory`
+    accounting costs a busy owner: each scan serves rpc_memory_summary
+    off the driver's IO loop (10k ref records built under the ref-table
+    lock) plus the agent/worker fan-out.  BEST-OF alternating pairs per
+    the slow-box protocol (see bench_trace_overhead).  Budget:
+    memory_scan_overhead_pct < 5."""
+    @ray_tpu.remote
+    def e():
+        return b"ok"
+
+    # every measured window must SPAN the scan cadence, or best-of
+    # selection just picks whichever run dodged the scans entirely
+    from ray_tpu._private.config import config as _cfg
+    min_window = 1.2 * float(_cfg.memory_scan_interval_s)
+
+    def measure():
+        ray_tpu.get([e.remote() for _ in range(100)], timeout=60)  # warm
+        t0 = time.perf_counter()
+        done = 0
+        while True:
+            ray_tpu.get([e.remote() for _ in range(n)], timeout=120)
+            done += n
+            elapsed = time.perf_counter() - t0
+            if elapsed >= min_window:
+                return done / elapsed
+
+    on_rates, off_rates = [], []
+    for _ in range(pairs):
+        off_rates.append(measure())
+        live = [ray_tpu.put(i) for i in range(live_objects)]
+        try:
+            on_rates.append(measure())
+        finally:
+            del live  # refs release; the table shrinks back
+    on, off = max(on_rates), max(off_rates)
+    return {
+        "scan_loaded_async_per_s": round(on, 1),
+        "scan_unloaded_async_per_s": round(off, 1),
+        # negative = loaded measured faster (noise); report as-is
+        "memory_scan_overhead_pct": round(100.0 * (off - on) / off, 2),
+    }
+
+
 def _serve_http_get(host, port, conns, total, path, timeout_s=120):
     """Drive the Serve proxy with `conns` keep-alive connections issuing
     `total` GET requests between them; returns (rps, p99_ms)."""
@@ -986,6 +1033,8 @@ def main():
             bench_trace_overhead(ray_tpu)))
         phase("profile_overhead", lambda: extras.update(
             bench_profile_overhead(ray_tpu)))
+        phase("memory_scan_overhead", lambda: extras.update(
+            bench_memory_scan_overhead(ray_tpu)))
         phase("burst_async", lambda: extras.__setitem__(
             "burst_async_per_s", round(bench_burst_then_async(ray_tpu), 1)))
         phase("head_scaling", lambda: extras.update(

@@ -91,6 +91,30 @@ _def("memory_monitor_test_usage_file", "")    # test hook: fraction in a file
 _def("task_events_buffer_size", 10_000)
 _def("metrics_report_interval_ms", 5_000)
 _def("event_stats", True)
+# --- memory/object accounting (rtpu memory / rtpu summary) -------------------
+# head-side leak-scan cadence: every interval the head joins the agents'
+# store breakdowns with the owners' reference tables, flags leaks, and
+# sets ray_tpu_object_leaked_bytes (0 disables the loop; on-demand
+# /api/memory views still work).  The scan fans out to every agent and
+# registered driver, so the cadence is deliberately lazy relative to
+# the TTL — detection latency is bounded by interval + ttl
+_def("memory_scan_interval_s", 5.0)
+# a borrowed ref still registered past this age, a pinned object with no
+# live owner older than this, or a channel slot no live compiled graph
+# claims for this long, is flagged in the `leaks` view
+_def("object_leak_ttl_s", 30.0)
+# bounded aggregation: refs per worker summary (largest first),
+# store entries per node payload, and objects in the head's joined
+# top-N table.  BOTH caps must sit far above normal working-set sizes:
+# truncating either marks the whole view partial, which suspends the
+# dead-owner/channel tripwires until the population shrinks (a 10k-ref
+# driver is an ordinary workload — see tests/test_scale.py).
+_def("memory_summary_max_refs", 20000)
+_def("memory_summary_max_objects", 20000)
+_def("memory_view_top_n", 50)
+# record the user call-site (file:line:function) on put()/.remote()
+# minted refs; False drops the ~µs frame walk from the submit hot path
+_def("memory_record_call_sites", True)
 # --- live introspection (see _private/profiling.py + log_monitor.py) ---------
 _def("profiler_default_hz", 99)            # sampling rate when none given
 _def("profiler_max_duration_s", 300.0)     # hard cap on one profile run

@@ -81,13 +81,14 @@ APP_JS = r"""// ray_tpu dashboard app (single file, no build step)
 "use strict";
 let SNAP = null;
 let TSERIES = null;  // /api/timeseries: head + per-agent gauge rings
+let MEM = null;      // /api/memory: joined memory/object accounting
 let TAB = "nodes";
 let TASK_FILTER = "";
 
 const TABS = [
   ["nodes", "Nodes"], ["actors", "Actors"], ["tasks", "Tasks"],
   ["pgs", "Placement groups"], ["jobs", "Jobs"], ["traces", "Traces"],
-  ["series", "Series"],
+  ["memory", "Memory"], ["series", "Series"],
 ];
 
 function el(tag, attrs, ...children) {
@@ -224,6 +225,73 @@ const VIEWS = {
       t.root || "", t.num_spans,
       (t.duration_s * 1000).toFixed(1) + " ms",
     ])),
+  // joined memory/object accounting (/api/memory): per-node byte
+  // breakdowns, top objects with owner + call-site, leak tripwires
+  memory: () => {
+    if (!MEM) return el("div", {class: "empty"}, "loading memory view…");
+    const fb = n => {
+      n = n || 0;
+      if (n < 1024) return n + "B";
+      if (n < 1048576) return (n / 1024).toFixed(1) + "KiB";
+      if (n < 1073741824) return (n / 1048576).toFixed(1) + "MiB";
+      return (n / 1073741824).toFixed(2) + "GiB";
+    };
+    const nodes = table(
+      ["node", "arena used/cap", "objects", "pinned", "channels",
+       "spilled", "mmap cache", "pulls in flight"],
+      Object.entries(MEM.nodes || {}).map(([nid, b]) => [
+        el("code", {}, nid.slice(0, 12)),
+        fb(b.arena_used) + " / " + fb(b.capacity),
+        b.num_objects,
+        fb(b.pinned_bytes),
+        (b.channel_slots || 0) + " (" + fb(b.channel_bytes) + ")",
+        fb(b.spilled_bytes) + " (" + (b.spilled_files || 0) + " files)",
+        fb(b.mmap_cache_bytes),
+        b.inflight_pulls || 0,
+      ]));
+    const lk = MEM.leaks || {};
+    // "DEAD" is only trustworthy on a complete join — a partial view
+    // (unreachable worker, truncated table) just means UNKNOWN owner
+    const noOwner = lk.partial ? "unknown" : "DEAD";
+    const objs = table(
+      ["object", "size", "node", "loc", "pins", "owner", "call-site"],
+      (MEM.objects || []).map(o => [
+        el("code", {}, (o.object_id || "").slice(0, 16)),
+        fb(o.size),
+        el("code", {}, (o.node_id || "").slice(0, 12)),
+        o.location + (o.channel ? " (chan)" : ""),
+        o.pins,
+        o.owner ? (o.owner.kind + ":" + o.owner.worker_id.slice(0, 8)
+                   + " " + (o.owner.name || ""))
+                : chip(noOwner),
+        el("code", {}, (o.owner && o.owner.call_site) || ""),
+      ]));
+    const leakRows = []
+      .concat((lk.dead_owner || []).map(e =>
+        ["dead-owner", e.object_id.slice(0, 16), fb(e.size),
+         Math.round(e.age_s) + "s", (e.node_id || "").slice(0, 12)]))
+      .concat((lk.borrowed_ttl || []).map(e =>
+        ["borrowed>TTL", e.object_id.slice(0, 16), fb(e.size),
+         Math.round(e.age_s) + "s", (e.worker_id || "").slice(0, 12)]))
+      .concat((lk.channel_slots || []).map(e =>
+        ["channel slot", e.object_id.slice(0, 16), fb(e.size),
+         Math.round(e.age_s) + "s", (e.node_id || "").slice(0, 12)]));
+    const attributed = MEM.store_object_bytes
+      ? Math.round(100 * MEM.attributed_bytes / MEM.store_object_bytes)
+      : 100;
+    return el("div", {},
+      el("div", {class: "tiles"},
+        tile("store objects", MEM.num_objects || 0),
+        tile("payload bytes", fb(MEM.store_object_bytes)),
+        tile("attributed to owners", attributed + "%"),
+        tile("leaked bytes", fb(lk.leaked_bytes))),
+      el("h3", {}, "per-node breakdown"), nodes,
+      el("h3", {}, "top objects"), objs,
+      el("h3", {}, "leaks" + (lk.partial ? " (partial view)" : "")),
+      leakRows.length
+        ? table(["kind", "object", "size", "age", "where"], leakRows)
+        : el("div", {class: "empty"}, "no leaks flagged"));
+  },
   // head time-series ring (/api/timeseries): loop lag and health
   // gauges per node, one sparkline tile per series
   series: () => {
@@ -258,15 +326,33 @@ function render() {
     const counts = {nodes: s.nodes.length, actors: s.actors.length,
                     tasks: s.tasks.length, pgs: s.placement_groups.length,
                     jobs: s.jobs.length, traces: (s.traces || []).length,
+                    memory: MEM ? (MEM.num_objects || 0) : 0,
                     series: ((TSERIES && TSERIES.series) || []).length};
     const b = el("button", {class: id === TAB ? "active" : "",
-                            onclick: () => { TAB = id; render(); }},
+                            onclick: () => {
+                              TAB = id;
+                              if (id === "memory")
+                                refreshMemory(true).then(render);
+                              render();
+                            }},
                  `${label} (${counts[id]})`);
     return b;
   }));
   document.getElementById("view").replaceChildren(VIEWS[TAB](s));
   document.getElementById("updated").textContent =
     "updated " + new Date().toLocaleTimeString();
+}
+
+let MEM_TS = 0;
+async function refreshMemory(force) {
+  // fetched only while the Memory tab is active, and at most every
+  // 10s: the view fans out to every agent + owner, so it must not
+  // ride the 2s background poll (force = explicit tab activation)
+  if (!force && Date.now() - MEM_TS < 10000) return;
+  MEM_TS = Date.now();
+  try {
+    MEM = await (await fetch("/api/memory")).json();
+  } catch (e) { /* memory tab degrades to loading note */ }
 }
 
 async function refresh() {
@@ -276,6 +362,7 @@ async function refresh() {
     try {
       TSERIES = await (await fetch("/api/timeseries")).json();
     } catch (e) { /* series tab degrades to empty */ }
+    if (TAB === "memory") await refreshMemory();
     document.getElementById("error").style.display = "none";
     render();
   } catch (e) {

@@ -33,9 +33,11 @@ from ray_tpu._private.config import config
 from ray_tpu._private.ids import JobID
 from ray_tpu._private.profiling import IntrospectionRpcMixin, loop_lag_probe
 from ray_tpu._private.resources import NodeResources, ResourceSet
-from ray_tpu._private.rpc import RpcClient, RpcHost, RpcServer, RpcError
+from ray_tpu._private.rpc import (RpcClient, RpcHost, RpcServer, RpcError,
+                                  is_loopback)
 from ray_tpu._private.scheduler import pick_node
-from ray_tpu._private.task_spec import TaskSpec
+from ray_tpu._private.task_spec import (ACTOR_CREATION_TASK, ACTOR_TASK,
+                                        NORMAL_TASK, TaskSpec)
 
 # Actor states (reference: rpc::ActorTableData::ActorState)
 PENDING, ALIVE, RESTARTING, DEAD = "PENDING", "ALIVE", "RESTARTING", "DEAD"
@@ -132,7 +134,7 @@ class _ActorEntry:
 class _NodeEntry:
     __slots__ = ("node_id", "host", "port", "arena_path", "resources",
                  "last_heartbeat", "client", "is_head_node",
-                 "pending_demands", "labels", "xfer_port")
+                 "pending_demands", "labels", "xfer_port", "memory")
 
     def __init__(self, node_id: str, host: str, port: int, arena_path: str,
                  resources: NodeResources, is_head_node: bool,
@@ -154,6 +156,9 @@ class _NodeEntry:
         self.labels: Dict[str, str] = labels or {}
         # bulk object-transfer plane listener (object_transfer.py)
         self.xfer_port = xfer_port
+        # latest store byte breakdown off this node's heartbeat — the
+        # cheap (no fan-out) half of /api/memory and rtpu summary
+        self.memory: Dict[str, Any] = {}
         # NOTE: object locations live in HeadService.dir (the sharded
         # object directory), no longer per-node snapshot maps here
 
@@ -255,6 +260,28 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         # node_id -> {rule_id: fired} from heartbeats (current version
         # only); status aggregates these with the head's own counts
         self._chaos_fired: Dict[str, Dict[str, int]] = {}
+        # memory/object accounting (rtpu memory): registered driver
+        # callback addresses by job id (bounded — oldest fall off), the
+        # pooled clients to them, and the periodic leak-scan task that
+        # feeds ray_tpu_object_leaked_bytes
+        from collections import OrderedDict as _OrderedDict
+
+        self.driver_addrs: Dict[str, Tuple[str, int]] = _OrderedDict()
+        self._driver_clients: Dict[Tuple[str, int], RpcClient] = {}
+        self._driver_join_gap = False
+        # drivers whose callback is unreachable (loopback addr from a
+        # remote peer): the join is gapped only while their connection
+        # lives — a PERMANENT flag here would turn the dead-owner and
+        # channel tripwires off forever on any multi-machine cluster
+        self._gapped_driver_conns: set = set()
+        # leak TTLs run from when an object was first seen UNCLAIMED
+        # (complete scans only), not from creation: an old object whose
+        # owner just exited gets a full TTL of grace for the in-flight
+        # store_free instead of being flagged on the next scan
+        self._unclaimed_since: Dict[str, float] = {}
+        self._memory_task: Optional[asyncio.Task] = None
+        self._last_memory_scan: Dict[str, Any] = {}
+        self._memview_inflight: Dict[Tuple[int, int], asyncio.Future] = {}
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -272,6 +299,9 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
             loop_lag_probe("head", on_sample=_lag))
         if self._state_path:
             self._persist_task = asyncio.ensure_future(self._persist_loop())
+        if float(config.memory_scan_interval_s) > 0:
+            self._memory_task = asyncio.ensure_future(
+                self._memory_scan_loop())
         await self._start_metrics(host)
         # resume interrupted scheduling work from the restored tables
         for actor in self.actors.values():
@@ -291,11 +321,18 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
             self._persist_task.cancel()
         if self._dash_task:
             self._dash_task.cancel()
+        if self._memory_task:
+            self._memory_task.cancel()
         if self._state_path and self._dirty:
             self._save_state()
-        for n in self.nodes.values():
+        # snapshot both tables: each close() yields, and a late register
+        # or reap can resize the dict mid-iteration
+        for n in list(self.nodes.values()):
             if n.client is not None:
                 await n.client.close()
+        for c in list(self._driver_clients.values()):
+            await c.close()
+        self._driver_clients.clear()
         if self._metrics_server is not None:
             self._metrics_server.close()
         if self._server:
@@ -325,6 +362,16 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
             "kv": dict(self.kv),
             "named_actors": dict(self.named_actors),
             "job_counter": self._next_job_int,
+            # memory aggregator callbacks: without these a head restart
+            # makes every live driver's objects look ownerless (and the
+            # dead-owner tripwire would flag them after one TTL)
+            "driver_addrs": {j: list(a)
+                             for j, a in self.driver_addrs.items()},
+            # conn-scoped gaps can't survive a restart (the conns are
+            # gone but the drivers may live on) — fold them into the
+            # permanent flag so the restarted head stays conservative
+            "driver_join_gap": (self._driver_join_gap
+                                or bool(self._gapped_driver_conns)),
             "cluster_version": self._cluster_version,
             "autoscaler_types": dict(self._autoscaler_types),
             "actors": [
@@ -389,6 +436,10 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         self.kv = dict(snap.get("kv", {}))
         self.named_actors = dict(snap.get("named_actors", {}))
         self._next_job_int = int(snap.get("job_counter", 1))
+        for j, a in (snap.get("driver_addrs") or {}).items():
+            self.driver_addrs[j] = (a[0], a[1])
+        self._driver_join_gap = bool(
+            snap.get("driver_join_gap", False))
         self._cluster_version = int(snap.get("cluster_version", 0))
         self._autoscaler_types = dict(snap.get("autoscaler_types", {}))
         for a in snap.get("actors", []):
@@ -486,12 +537,15 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                             objects_delta: Optional[Dict[str, Any]] = None,
                             dir_versions: Optional[List[int]] = None,
                             metrics: Optional[Dict[str, float]] = None,
+                            memory: Optional[Dict[str, Any]] = None,
                             seen_chaos_version: int = 0,
                             chaos_fired: Optional[Dict[str, int]] = None):
         entry = self.nodes.get(node_id)
         if entry is None:
             return {"unknown_node": True}
         entry.last_heartbeat = time.monotonic()
+        if memory:
+            entry.memory = memory
         if metrics:
             now = time.time()
             for name, value in metrics.items():
@@ -676,6 +730,9 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         node_id = self._node_conns.pop(conn, None)
         if node_id is not None and node_id in self.nodes:
             asyncio.ensure_future(self._on_node_dead(node_id, "connection lost"))
+        if conn in self._gapped_driver_conns:
+            self._gapped_driver_conns.discard(conn)
+            self.mark_dirty()
 
     async def _health_loop(self):
         period = config.gcs_health_check_period_ms / 1000.0
@@ -741,9 +798,49 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
 
     # ---- jobs --------------------------------------------------------------
 
-    async def rpc_register_job(self, driver_addr: Optional[List] = None):
+    async def rpc_register_job(self, driver_addr: Optional[List] = None,
+                               _conn=None):
         jid = JobID.from_int(self._next_job_int)
         self._next_job_int += 1
+        if driver_addr:
+            # callback address for the memory aggregator (drivers own
+            # most refs but are not pooled by any agent).  A loopback
+            # callback registered from a REMOTE peer would have the head
+            # dial its OWN loopback, not the driver: record nothing and
+            # mark the join gapped, so the unreachable driver's refs are
+            # a known gap rather than false dead_owner leaks.  Dead
+            # drivers are pruned by the scan fan-out (_drop_driver); the
+            # cap is a backstop against registration floods, and
+            # evicting a possibly-LIVE driver poisons the ownership
+            # join, so an eviction (pathological: >256 concurrent
+            # drivers) likewise marks memory views partial from then on
+            # — absence-of-owner can no longer be trusted as a death
+            # signal.
+            peer = (_conn.writer.get_extra_info("peername")
+                    if _conn is not None else None)
+            sock = (_conn.writer.get_extra_info("sockname")
+                    if _conn is not None else None)
+            # same-machine drivers CAN be dialed back on loopback even
+            # when they reached the head via its LAN address — and a
+            # local connection to the machine's own LAN IP bears that
+            # IP on BOTH endpoints, so peer==sock host means local
+            if (is_loopback(driver_addr[0]) and peer
+                    and not is_loopback(peer[0])
+                    and not (sock and peer[0] == sock[0])):
+                # gap scoped to the driver's connection: cleared when it
+                # disconnects (its refs die with it), so one remote
+                # driver doesn't disable leak detection forever
+                if _conn is not None:
+                    self._gapped_driver_conns.add(_conn)
+                else:
+                    self._driver_join_gap = True
+            else:
+                self.driver_addrs[jid.hex()] = (driver_addr[0],
+                                                driver_addr[1])
+                while len(self.driver_addrs) > 256:
+                    j, a = next(iter(self.driver_addrs.items()))
+                    self._driver_join_gap = True
+                    self._drop_driver(j, a)
         self.mark_dirty()
         return {"job_id": jid.hex()}
 
@@ -1479,6 +1576,11 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                 return self._http_profile(query)
 
             profile_route.wants_query = True
+
+            def memory_route(query: str = ""):
+                return self._http_memory(query)
+
+            memory_route.wants_query = True
             self._metrics_server, self.metrics_port = \
                 await start_metrics_http_server(
                     default_registry, host,
@@ -1497,6 +1599,8 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                         "/api/timeseries": self._render_timeseries_json,
                         "/api/stack": stack_route,
                         "/api/profile": profile_route,
+                        "/api/memory": memory_route,
+                        "/api/summary": self._render_summary_json,
                     })
             self._dash_task = asyncio.ensure_future(self._dash_sample_loop())
         except Exception:
@@ -1940,6 +2044,399 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
             *(one(n) for n in list(self.nodes.values())))
         out: List[Dict[str, Any]] = [o for objs in results for o in objs]
         return {"objects": out[:limit]}
+
+    # ---- memory & object accounting (rtpu memory / rtpu summary;
+    # reference: `ray memory` + `ray summary` — state_aggregator.py
+    # joining per-worker ownership dumps with per-raylet store stats) -------
+
+    def _driver_client(self, addr: Tuple[str, int]) -> RpcClient:
+        addr = (addr[0], addr[1])
+        c = self._driver_clients.get(addr)
+        if c is None or c.dead:
+            if c is not None:
+                asyncio.ensure_future(c.close())
+            c = RpcClient(addr[0], addr[1], label=f"driver-{addr[1]}")
+            self._driver_clients[addr] = c
+        return c
+
+    def _drop_driver(self, jid: str, addr: Tuple[str, int]) -> None:
+        """Forget a driver whose process is gone: its callback address
+        and pooled client.  Its still-pinned primary bytes now have no
+        claiming owner — the dead-owner tripwire's job."""
+        self.driver_addrs.pop(jid, None)
+        c = self._driver_clients.pop((addr[0], addr[1]), None)
+        if c is not None:
+            asyncio.ensure_future(c.close())
+
+    async def _memory_view(self, top_n: int = 0,
+                           limit: int = 0) -> Dict[str, Any]:
+        """Single-flight wrapper over the cluster fan-out: the 5s scan
+        loop, dashboard viewers and CLI/state callers all want the same
+        join — concurrent requests with the same bounds share ONE
+        in-flight fan-out instead of each dialing every agent, worker
+        and driver (callers treat the returned view as read-only)."""
+        key = (int(top_n), int(limit))
+        fut = self._memview_inflight.get(key)
+        if fut is None:
+            fut = asyncio.ensure_future(
+                self._memory_view_fanout(top_n=top_n, limit=limit))
+            self._memview_inflight[key] = fut
+            fut.add_done_callback(
+                lambda _f, k=key: self._memview_inflight.pop(k, None))
+        return await asyncio.shield(fut)
+
+    async def _memory_view_fanout(self, top_n: int = 0,
+                                  limit: int = 0) -> Dict[str, Any]:
+        """Join the cluster's memory accounting into one view: per-node
+        store byte breakdowns + object tables (agent fan-out, each agent
+        adding its pooled workers' reference summaries) + registered
+        drivers' reference summaries, then run the leak tripwires over
+        the join.  Bounded everywhere: `limit` refs per owner, `top_n`
+        objects in the joined table."""
+        top_n = int(top_n) or int(config.memory_view_top_n)
+        limit = int(limit) or int(config.memory_summary_max_refs)
+        ttl = float(config.object_leak_ttl_s)
+        node_payloads: Dict[str, Dict[str, Any]] = {}
+        owner_summaries: List[Dict[str, Any]] = []
+        fanout_errors: List[str] = []
+
+        async def one_node(node: _NodeEntry):
+            try:
+                node_payloads[node.node_id] = await self._node_client(
+                    node).call("node_memory", limit=limit, timeout=15.0)
+            except Exception as e:
+                fanout_errors.append(f"node {node.node_id[:12]}: {e}")
+
+        from ray_tpu._private.rpc import ConnectionLost
+
+        async def one_driver(jid: str, addr: Tuple[str, int]):
+            try:
+                try:
+                    s = await self._driver_client(addr).call(
+                        "memory_summary", limit=limit, timeout=5.0)
+                except ConnectionLost:
+                    # the POOLED connection died — which also happens
+                    # when a transient reset severs a socket under a
+                    # live driver.  Verify death with one fresh dial
+                    # before trusting it as a death signal.
+                    old = self._driver_clients.pop((addr[0], addr[1]),
+                                                   None)
+                    if old is not None:
+                        asyncio.ensure_future(old.close())
+                    s = await self._driver_client(addr).call(
+                        "memory_summary", limit=limit, timeout=5.0)
+                s["job_id"] = jid
+                owner_summaries.append(s)
+            except asyncio.TimeoutError:
+                # a TIMEOUT is a busy driver, not a death signal — and on
+                # 3.11+ TimeoutError subclasses OSError, so it must be
+                # caught BEFORE the process-GONE branch below or a slow
+                # driver gets permanently dropped and its objects flagged
+                fanout_errors.append(f"driver {jid[:12]}: timeout")
+            except (ConnectionLost, ConnectionRefusedError):
+                # process GONE — a refused or severed FRESH dial is
+                # real death evidence: its owned objects now have no
+                # live owner, exactly what the dead-owner tripwire
+                # flags.  Drop it so churned drivers don't accumulate.
+                self._drop_driver(jid, addr)
+            except OSError as e:
+                # any other OSError is a LOCAL dial failure (fd
+                # pressure, ENOBUFS) and says nothing about the driver:
+                # a gap, never a death signal
+                fanout_errors.append(f"driver {jid[:12]}: {e!r:.60}")
+            except Exception as e:
+                # alive but not answering (busy loop, slow box): its
+                # refs are a GAP, not a death signal — the join is
+                # partial and absence-of-owner must not be trusted
+                fanout_errors.append(f"driver {jid[:12]}: {e!r:.60}")
+
+        await asyncio.gather(
+            *(one_node(n) for n in list(self.nodes.values())),
+            *(one_driver(j, a) for j, a in list(self.driver_addrs.items())))
+        for p in node_payloads.values():
+            for wid, s in (p.get("workers") or {}).items():
+                if isinstance(s, dict) and not s.get("error"):
+                    owner_summaries.append(s)
+                else:
+                    fanout_errors.append(f"worker {wid[:12]}")
+
+        # owner join: oid -> owning worker + call-site.  `complete` means
+        # every reachable owner reported an untruncated table, every
+        # node reported its full object list, and no agent/worker/driver
+        # fan-out failed — only then can "no owner claims this object"
+        # be trusted as a death signal rather than a gap.
+        if self._gapped_driver_conns:
+            fanout_errors.append(
+                f"{len(self._gapped_driver_conns)} driver(s) with "
+                f"unreachable loopback callback")
+        complete = not fanout_errors and not self._driver_join_gap
+        for nid, p in node_payloads.items():
+            total = (p.get("breakdown") or {}).get("num_objects", 0)
+            if total > len(p.get("objects") or ()):
+                complete = False
+                fanout_errors.append(
+                    f"node {nid[:12]}: object list truncated "
+                    f"({len(p['objects'])}/{total})")
+        owned_by_oid: Dict[str, Dict[str, Any]] = {}
+        live_channels: set = set()
+        for s in owner_summaries:
+            if s.get("truncated"):
+                complete = False
+            for r in s.get("owned") or ():
+                owned_by_oid[r["oid"]] = {
+                    "worker_id": s.get("worker_id", ""),
+                    "kind": s.get("kind", ""),
+                    "call_site": r.get("call_site", ""),
+                    "name": r.get("name", ""),
+                    "size": r.get("size", 0),
+                }
+            live_channels.update(s.get("channels") or ())
+
+        objects: List[Dict[str, Any]] = []
+        leaks: Dict[str, Any] = {"dead_owner": [], "borrowed_ttl": [],
+                                 "channel_slots": [],
+                                 "partial": not complete,
+                                 "ttl_s": ttl}
+        store_object_bytes = attributed_bytes = 0
+        size_by_oid: Dict[str, int] = {}
+        # TTL clocks run from first-seen-unclaimed, tracked only across
+        # COMPLETE scans (absence-of-owner means nothing on a partial
+        # one, and a partial blip must not reset a running clock)
+        now = time.time()
+        seen_unclaimed: set = set()
+
+        def unclaimed_past_ttl(oid: str) -> Tuple[bool, float]:
+            t0 = self._unclaimed_since.setdefault(oid, now)
+            seen_unclaimed.add(oid)
+            return now - t0 > ttl, now - t0
+
+        for nid, p in node_payloads.items():
+            for o in p.get("objects") or ():
+                o = dict(o)
+                o["node_id"] = nid
+                size_by_oid[o["object_id"]] = o.get("size", 0)
+                own = owned_by_oid.get(o["object_id"])
+                if own is not None:
+                    o["owner"] = {k: own[k] for k in
+                                  ("worker_id", "kind", "call_site", "name")}
+                objects.append(o)
+                if o.get("freed") or not o.get("sealed"):
+                    continue
+                if o.get("channel"):
+                    if complete and o["object_id"] not in live_channels:
+                        past, unclaimed_s = unclaimed_past_ttl(
+                            o["object_id"])
+                        if past:
+                            leaks["channel_slots"].append({
+                                "object_id": o["object_id"],
+                                "node_id": nid, "size": o["size"],
+                                "age_s": o["age_s"],
+                                "unclaimed_s": round(unclaimed_s, 1)})
+                    continue
+                store_object_bytes += o["size"]
+                if own is not None:
+                    attributed_bytes += o["size"]
+                elif complete and o.get("primary"):
+                    # primary bytes no live owner claims: nobody will
+                    # ever send the store_free for them
+                    past, unclaimed_s = unclaimed_past_ttl(o["object_id"])
+                    if past:
+                        leaks["dead_owner"].append({
+                            "object_id": o["object_id"], "node_id": nid,
+                            "size": o["size"], "age_s": o["age_s"],
+                            "unclaimed_s": round(unclaimed_s, 1),
+                            "pins": o.get("pins", 0)})
+        if complete:
+            # an oid freed or claimed again resets its clock; pruning
+            # only on complete scans keeps the map bounded by the live
+            # unclaimed population
+            self._unclaimed_since = {
+                k: v for k, v in self._unclaimed_since.items()
+                if k in seen_unclaimed}
+        for s in owner_summaries:
+            for r in s.get("borrowed") or ():
+                if r.get("age_s", 0) > ttl:
+                    own = owned_by_oid.get(r["oid"])
+                    # borrowers don't know sizes — backfill from the
+                    # store entry or the owner's own table
+                    size = (r.get("size") or size_by_oid.get(r["oid"])
+                            or (own or {}).get("size", 0))
+                    leaks["borrowed_ttl"].append({
+                        "object_id": r["oid"],
+                        "worker_id": s.get("worker_id", ""),
+                        "size": size, "age_s": r["age_s"],
+                        "owner_known": own is not None})
+        # an object can trip more than one wire (dead owner AND a stale
+        # borrow) — count its bytes once
+        leaked_by_oid: Dict[str, int] = {}
+        for kind in ("dead_owner", "borrowed_ttl", "channel_slots"):
+            for e in leaks[kind]:
+                leaked_by_oid[e["object_id"]] = max(
+                    leaked_by_oid.get(e["object_id"], 0), e["size"])
+        leaks["leaked_bytes"] = sum(leaked_by_oid.values())
+        objects.sort(key=lambda o: -o.get("size", 0))
+        owners = [{"worker_id": s.get("worker_id", ""),
+                   "kind": s.get("kind", ""),
+                   "node_id": s.get("node_id", ""),
+                   "job_id": s.get("job_id", ""),
+                   "num_owned": s.get("num_owned", 0),
+                   "num_borrowed": s.get("num_borrowed", 0),
+                   "owned_bytes": s.get("owned_bytes", 0)}
+                  for s in owner_summaries]
+        return {
+            "nodes": {nid: p.get("breakdown", {})
+                      for nid, p in node_payloads.items()},
+            "objects": objects[:top_n],
+            "num_objects": len(objects),
+            "store_object_bytes": store_object_bytes,
+            "attributed_bytes": attributed_bytes,
+            "owners": owners,
+            "leaks": leaks,
+            "errors": fanout_errors,
+            "ts": time.time(),
+        }
+
+    async def rpc_memory_view(self, top_n: int = 0, limit: int = 0):
+        return await self._memory_view(top_n=top_n, limit=limit)
+
+    async def _memory_scan_loop(self):
+        """Leak tripwire: periodically run the joined memory view and
+        publish per-kind leaked bytes as ray_tpu_object_leaked_bytes.
+        The gauge is re-set every scan, so cleaned-up leaks drop it back
+        to 0 within one interval."""
+        from ray_tpu._private.metrics import (memory_scan_partial_gauge,
+                                              object_leaked_bytes_gauge)
+
+        gauge = object_leaked_bytes_gauge()
+        partial_gauge = memory_scan_partial_gauge()
+        kinds = {"dead_owner": "dead_owner", "borrowed_ttl": "borrowed_ttl",
+                 "channel_slots": "channel_slot"}
+        while True:
+            await asyncio.sleep(
+                max(0.1, float(config.memory_scan_interval_s)))
+            try:
+                view = await self._memory_view()
+            except Exception:
+                continue
+            leaks = view.get("leaks") or {}
+            partial = bool(leaks.get("partial"))
+            # partialness is its own signal: while 1, leak detection is
+            # suspended and the held leak values below are stale
+            partial_gauge.set(1.0 if partial else 0.0)
+            # EVERY kind can false-all-clear on a partial join:
+            # dead_owner/channel_slots are emptied by the complete-gate,
+            # and an unreachable BORROWER empties its borrowed_ttl
+            # records — hold the last COMPLETE values (gauge and
+            # summary banner alike) rather than dropping a live alert
+            # to 0
+            if partial:
+                prev = self._last_memory_scan
+                self._last_memory_scan = {
+                    "ts": view.get("ts"), "partial": True,
+                    "leaked_bytes": prev.get("leaked_bytes", 0),
+                    "counts": prev.get("counts",
+                                       {k: 0 for k in kinds}),
+                }
+                continue
+            for key, label in kinds.items():
+                gauge.set(
+                    sum(e.get("size", 0) for e in leaks.get(key, ())),
+                    tags={"kind": label})
+            self._last_memory_scan = {
+                "ts": view.get("ts"),
+                "partial": False,
+                "leaked_bytes": leaks.get("leaked_bytes", 0),
+                "counts": {k: len(leaks.get(k, ())) for k in kinds},
+            }
+
+    @staticmethod
+    def _percentiles(vals: List[float]) -> Optional[Dict[str, Any]]:
+        if not vals:
+            return None
+        s = sorted(vals)
+        n = len(s)
+        return {"count": n,
+                "p50_ms": round(s[n // 2] * 1000, 3),
+                "p99_ms": round(s[min(n - 1, int(n * 0.99))] * 1000, 3),
+                "mean_ms": round(sum(s) / n * 1000, 3),
+                "max_ms": round(s[-1] * 1000, 3)}
+
+    def _cluster_summary(self) -> Dict[str, Any]:
+        """`rtpu summary`: per-function task aggregates (state counts +
+        queued/running percentiles off the task-event store), actor
+        counts + per-method call counts, and the per-node object-store
+        rollup from heartbeat breakdowns.  All local state — no fan-out,
+        cheap enough to poll."""
+        tasks: Dict[str, Dict[str, Any]] = {}
+        methods: Dict[str, int] = {}
+        for rec in self.task_events.values():
+            name = rec.get("name") or "?"
+            kind = rec.get("kind", NORMAL_TASK)
+            row = tasks.get(name)
+            if row is None:
+                row = tasks[name] = {"kind": kind, "states": {},
+                                     "queued_s": [], "running_s": []}
+            st = rec.get("state", "?")
+            row["states"][st] = row["states"].get(st, 0) + 1
+            sub = rec.get("submitted_ts")
+            run = rec.get("running_ts")
+            end = rec.get("finished_ts") or rec.get("failed_ts")
+            lease = rec.get("leased_ts") or run
+            if sub is not None and lease is not None:
+                row["queued_s"].append(max(0.0, lease - sub))
+            if run is not None and end is not None:
+                row["running_s"].append(max(0.0, end - run))
+            if kind == ACTOR_TASK:
+                methods[name] = methods.get(name, 0) + 1
+        kind_names = {NORMAL_TASK: "task", ACTOR_CREATION_TASK:
+                      "actor_creation", ACTOR_TASK: "actor_method"}
+        out_tasks = {
+            name: {"kind": kind_names.get(row["kind"], str(row["kind"])),
+                   "states": row["states"],
+                   "queued": self._percentiles(row["queued_s"]),
+                   "running": self._percentiles(row["running_s"])}
+            for name, row in tasks.items()}
+        actor_states: Dict[str, int] = {}
+        for a in self.actors.values():
+            actor_states[a.state] = actor_states.get(a.state, 0) + 1
+        node_mem = {nid: dict(n.memory) for nid, n in self.nodes.items()
+                    if n.memory}
+        objects = {
+            "nodes": node_mem,
+            "total_arena_used": sum(m.get("arena_used", 0)
+                                    for m in node_mem.values()),
+            "total_pinned_bytes": sum(m.get("pinned_bytes", 0)
+                                      for m in node_mem.values()),
+            "total_spilled_bytes": sum(m.get("spilled_bytes", 0)
+                                       for m in node_mem.values()),
+            "total_channel_bytes": sum(m.get("channel_bytes", 0)
+                                       for m in node_mem.values()),
+            "total_objects": sum(m.get("num_objects", 0)
+                                 for m in node_mem.values()),
+        }
+        return {"tasks": out_tasks,
+                "actors": {"by_state": actor_states,
+                           "num_actors": len(self.actors),
+                           "methods": methods},
+                "objects": objects,
+                "last_leak_scan": dict(self._last_memory_scan),
+                "ts": time.time()}
+
+    async def rpc_cluster_summary(self):
+        return self._cluster_summary()
+
+    async def _http_memory(self, query: str = ""):
+        import json as _json
+
+        p = self._query_params(query)
+        out = await self._memory_view(top_n=int(p.get("top", 0) or 0))
+        return "application/json", _json.dumps(out, default=str).encode()
+
+    def _render_summary_json(self):
+        import json as _json
+
+        return "application/json", _json.dumps(
+            self._cluster_summary(), default=str).encode()
 
     # ---- autoscaler --------------------------------------------------------
 

@@ -444,6 +444,65 @@ def fault_tolerance_metrics() -> Tuple[Counter, Counter, Counter]:
     return _ft_metrics
 
 
+_leaked_bytes_gauge: Optional[Gauge] = None
+
+
+def object_leaked_bytes_gauge() -> Gauge:
+    """Process-singleton ``ray_tpu_object_leaked_bytes``: bytes the
+    head's periodic memory scan attributes to leaks, labeled by
+    kind=dead_owner|borrowed_ttl|channel_slot (head.py leak tripwires).
+    Set on every complete scan, so it returns to 0 once the leak is
+    cleaned up.  Alert on dead_owner/channel_slot staying nonzero —
+    those are definite leaks.  borrowed_ttl is a SUSPICION signal: a
+    borrow older than the TTL is indistinguishable from an actor
+    legitimately caching refs for the job's lifetime, so long-running
+    workloads keep it nonzero by design (tune object_leak_ttl_s to
+    your hold patterns before paging on it)."""
+    global _leaked_bytes_gauge
+    if _leaked_bytes_gauge is None:
+        _leaked_bytes_gauge = Gauge(
+            "ray_tpu_object_leaked_bytes",
+            "object-store bytes flagged as leaked by the head memory scan")
+    return _leaked_bytes_gauge
+
+
+_scan_partial_gauge: Optional[Gauge] = None
+
+
+def memory_scan_partial_gauge() -> Gauge:
+    """Process-singleton ``ray_tpu_memory_scan_partial``: 1 while the
+    head's leak scan sees a partial ownership join (unreachable owner,
+    truncated table, gapped driver) — leak values hold their last
+    complete reading during that time, so a frozen
+    ``ray_tpu_object_leaked_bytes`` is only trustworthy when this is
+    0.  Alert on it staying 1."""
+    global _scan_partial_gauge
+    if _scan_partial_gauge is None:
+        _scan_partial_gauge = Gauge(
+            "ray_tpu_memory_scan_partial",
+            "1 while the head memory scan's ownership join is partial "
+            "(leak detection suspended, gauges hold last complete values)")
+    return _scan_partial_gauge
+
+
+_store_breakdown_gauge: Optional[Gauge] = None
+
+
+def object_store_breakdown_gauge() -> Gauge:
+    """Process-singleton ``ray_tpu_object_store_bytes``: the node
+    store's byte breakdown, labeled by kind=arena_used|arena_free|
+    pinned|spilled|channel|mmap_cache — sampled by an agent collector at
+    scrape time from StoreCore.byte_breakdown().  The per-node half of
+    `rtpu memory`, exported so dashboards can graph who owns the arena
+    without polling the state API."""
+    global _store_breakdown_gauge
+    if _store_breakdown_gauge is None:
+        _store_breakdown_gauge = Gauge(
+            "ray_tpu_object_store_bytes",
+            "node object-store bytes by kind (arena/pinned/spilled/...)")
+    return _store_breakdown_gauge
+
+
 _serve_request_latency: Optional[Histogram] = None
 
 

@@ -50,7 +50,7 @@ from ray_tpu._private.task_spec import TaskSpec
 
 class _Worker:
     __slots__ = ("worker_id", "pid", "proc", "port", "ready", "lease_id",
-                 "started_at", "env_key", "idle_since")
+                 "started_at", "env_key", "idle_since", "iclient")
 
     def __init__(self, worker_id: str, proc: subprocess.Popen,
                  env_key: str = ""):
@@ -61,6 +61,10 @@ class _Worker:
         self.ready = asyncio.Event()
         self.lease_id: Optional[str] = None
         self.started_at = time.monotonic()
+        # pooled introspection client (stacks/profile/memory fan-outs):
+        # the periodic memory scan would otherwise dial a fresh TCP
+        # connection per worker per scan, forever
+        self.iclient: Optional["RpcClient"] = None
         # workers are pooled per runtime-env identity: an env-X lease
         # never reuses an env-Y worker (reference: worker_pool.h keys
         # idle workers by runtime env hash)
@@ -174,6 +178,11 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         self._workers: Dict[str, _Worker] = {}   # worker_id -> worker
         self._idle: List[_Worker] = []
         self._starting = 0
+        # bounds concurrent worker spawns (worker_startup_parallelism);
+        # created lazily so __init__ needs no running loop
+        self._spawn_sem: Optional[asyncio.Semaphore] = None
+        # (ts, breakdown) reused by heartbeats — see _memory_breakdown
+        self._breakdown_cache: Optional[Tuple[float, Dict[str, Any]]] = None
         self._leases: Dict[str, _Lease] = {}
         self._lease_counter = 0
         self._lease_waiters: Dict[object, asyncio.Future] = {}
@@ -258,12 +267,24 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         leases_g = Gauge("rt_leases_active", "granted worker leases")
         queued_g = Gauge("rt_lease_queue_depth", "lease requests queued")
 
+        from ray_tpu._private.metrics import object_store_breakdown_gauge
+
+        breakdown_g = object_store_breakdown_gauge()
+
         def collect():
             try:
                 u = self.store.usage()
                 store_bytes.set(u.get("allocated", 0))
                 store_objs.set(u.get("num_objects", 0))
                 store_cap.set(u.get("capacity", 0))
+                b = self._memory_breakdown(max_age_s=5.0)
+                for kind, key in (("arena_used", "arena_used"),
+                                  ("arena_free", "arena_free"),
+                                  ("pinned", "pinned_bytes"),
+                                  ("spilled", "spilled_bytes"),
+                                  ("channel", "channel_bytes"),
+                                  ("mmap_cache", "mmap_cache_bytes")):
+                    breakdown_g.set(b.get(key, 0), tags={"kind": kind})
             except Exception:
                 pass
             workers_g.set(len(self._workers))
@@ -292,6 +313,60 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         node_manager.proto:405 GetObjectsInfo)."""
         return {"objects": self.store.list_objects(limit)}
 
+    def _memory_breakdown(self, max_age_s: float = 0.0) -> Dict[str, Any]:
+        """Store byte breakdown plus the agent-side caches the store
+        can't see: the transfer plane's cross-pull mmap cache and pulls
+        in flight right now.  byte_breakdown() walks every store entry,
+        so periodic callers (heartbeats) pass max_age_s to reuse a
+        recent snapshot instead of re-walking a large store each beat;
+        the memory view's fan-out always computes fresh."""
+        now = time.monotonic()
+        if (max_age_s > 0.0 and self._breakdown_cache is not None
+                and now - self._breakdown_cache[0] <= max_age_s):
+            return self._breakdown_cache[1]
+        b = self.store.byte_breakdown()
+        cache = self._xfer.cache_stats()
+        b["mmap_cache_bytes"] = cache["bytes"]
+        b["mmap_cache_files"] = cache["files"]
+        b["inflight_pulls"] = len(self._pulls)
+        self._breakdown_cache = (now, b)
+        return b
+
+    async def rpc_node_memory(self, limit: int = 0,
+                              include_workers: bool = True,
+                              timeout_s: float = 5.0):
+        """The node's full memory/object accounting payload for the
+        head aggregator: byte breakdown, per-object store entries, and
+        (fan-out, like node_stacks) every pooled worker's reference
+        summary."""
+        limit = int(limit) or int(config.memory_summary_max_refs)
+        result: Dict[str, Any] = {
+            "node_id": self.node_id,
+            "breakdown": self._memory_breakdown(),
+            # `limit` caps refs per WORKER summary; the store listing has
+            # its own, much higher cap — truncating it marks the whole
+            # view partial and turns the leak tripwires off
+            "objects": self.store.list_objects(
+                int(config.memory_summary_max_objects)),
+            "workers": {},
+        }
+
+        async def one(w: _Worker):
+            try:
+                result["workers"][w.worker_id] = await asyncio.wait_for(
+                    self._call_worker(w, "memory_summary", timeout_s,
+                                      limit=limit),
+                    timeout_s + 1.0)
+            except Exception as e:
+                result["workers"][w.worker_id] = {
+                    "error": f"{type(e).__name__}: {e}"}
+
+        if include_workers:
+            await asyncio.gather(
+                *(one(w) for w in list(self._workers.values())
+                  if w.ready.is_set() and w.port and w.proc.poll() is None))
+        return result
+
     async def stop(self):
         self._log.stop()
         for t in self._tasks:
@@ -309,6 +384,10 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
                     w.proc.kill()
                 except Exception:
                     pass
+        for w in list(self._workers.values()):
+            if w.iclient is not None:
+                await w.iclient.close()
+                w.iclient = None
         if self._head:
             await self._head.close()
         for c in self._peers.values():
@@ -455,6 +534,7 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
                     objects_delta=delta,
                     dir_versions=self._dir_mirror.seen_versions(),
                     metrics=self._metric_summary(),
+                    memory=self._memory_breakdown(max_age_s=5.0),
                     seen_chaos_version=self._seen_chaos_version,
                     chaos_fired=fault_injection.fired_counts() or None)
                 self._apply_chaos(reply.get("chaos"))
@@ -973,6 +1053,9 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
             return
         # log monitor drains the file once more, then evicts it
         self._log.mark_dead(worker_id)
+        if w.iclient is not None:
+            asyncio.ensure_future(w.iclient.close())
+            w.iclient = None
         if w in self._idle:
             self._idle.remove(w)
         if not w.ready.is_set():
@@ -1605,6 +1688,12 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
             "tpu_chips": lease.tpu_chips,
         }}
 
+    def _spawn_gate(self) -> asyncio.Semaphore:
+        if self._spawn_sem is None:
+            self._spawn_sem = asyncio.Semaphore(
+                max(1, int(config.worker_startup_parallelism)))
+        return self._spawn_sem
+
     async def _pop_worker(self, renv: Optional[Dict[str, Any]] = None
                           ) -> Optional[_Worker]:
         from ray_tpu._private.runtime_env import env_key as _env_key
@@ -1626,7 +1715,7 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
             spawn_kwargs = {"env_key": key, "extra_env": env_vars,
                             "working_dir": working_dir,
                             "path_dirs": path_dirs}
-        for _attempt in range(3):
+        def pop_idle() -> Optional[_Worker]:
             for i in range(len(self._idle) - 1, -1, -1):
                 w = self._idle[i]
                 if w.env_key != key:
@@ -1635,17 +1724,34 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
                 if w.proc.poll() is None:
                     return w
                 self._on_worker_dead(w.worker_id, "dead on pop")
-            w = self._spawn_worker(**spawn_kwargs)
-            try:
-                await asyncio.wait_for(w.ready.wait(),
-                                       config.worker_register_timeout_s)
-            except asyncio.TimeoutError:
+            return None
+
+        for _attempt in range(3):
+            w = pop_idle()
+            if w is not None:
+                return w
+            # spawn throttle: N concurrent lease grants must not fork N
+            # interpreters at once — an unbounded spawn storm (200 actor
+            # creations) starves every child of CPU until ALL of them
+            # miss the register timeout and the whole batch dies.  The
+            # gate bounds concurrent starting workers to
+            # worker_startup_parallelism; the register-timeout clock only
+            # starts once the spawn actually begins.
+            async with self._spawn_gate():
+                w = pop_idle()  # freed while queued at the gate
+                if w is not None:
+                    return w
+                w = self._spawn_worker(**spawn_kwargs)
                 try:
-                    w.proc.kill()
-                except Exception:
-                    pass
-                self._on_worker_dead(w.worker_id, "startup timeout")
-                return None
+                    await asyncio.wait_for(w.ready.wait(),
+                                           config.worker_register_timeout_s)
+                except asyncio.TimeoutError:
+                    try:
+                        w.proc.kill()
+                    except Exception:
+                        pass
+                    self._on_worker_dead(w.worker_id, "startup timeout")
+                    return None
             if w.worker_id not in self._workers:  # died during startup
                 return None
             if w.lease_id is not None:
@@ -1854,13 +1960,15 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
 
     async def _call_worker(self, w: _Worker, method: str, timeout: float,
                            **payload):
-        """One transient RPC to a pooled worker's server (introspection
-        only — not a task-path connection, so no pooling needed)."""
-        client = RpcClient("127.0.0.1", w.port, label=f"introspect-{w.pid}")
-        try:
-            return await client.call(method, timeout=timeout, **payload)
-        finally:
-            await client.close()
+        """Introspection RPC to a pooled worker's server over a pooled
+        per-worker client (reconnect-on-demand): the 5s memory scan
+        fans out to every worker, so a transient connection per call
+        would be N dial/close cycles per scan, forever.  Closed by
+        _on_worker_dead / stop()."""
+        if w.iclient is None:
+            w.iclient = RpcClient("127.0.0.1", w.port,
+                                  label=f"introspect-{w.pid}")
+        return await w.iclient.call(method, timeout=timeout, **payload)
 
     async def rpc_node_stacks(self, timeout_s: float = 5.0):
         """Aggregate live stack dumps: this agent process plus every

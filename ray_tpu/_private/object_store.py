@@ -390,6 +390,49 @@ class StoreCore:
             "num_evicted": self.num_evicted,
         }
 
+    def byte_breakdown(self) -> Dict[str, Any]:
+        """Who owns this store's bytes — the node half of `rtpu memory`
+        (reference role: the `ray memory --stats-only` store stats).
+
+        Buckets are over the ALIGNED footprint for shm entries (what the
+        allocator actually charges), so `shm_bytes` reconciles exactly
+        with the allocator's own `arena_used` gauge; `object_bytes` is
+        the raw payload sum the owner-side reference tables attribute.
+        """
+        out = {
+            "capacity": self.alloc.capacity,
+            "arena_used": self.alloc.allocated,
+            "arena_free": self.alloc.capacity - self.alloc.allocated,
+            "shm_bytes": 0, "object_bytes": 0,
+            "pinned_bytes": 0, "pinned_objects": 0,
+            "channel_bytes": 0, "channel_slots": 0,
+            "spilled_bytes": 0, "spilled_files": 0,
+            "unsealed_bytes": 0, "freed_pending_bytes": 0,
+            "num_objects": len(self.objects),
+            "num_spilled": self.num_spilled,
+            "num_evicted": self.num_evicted,
+        }
+        for oid, e in self.objects.items():
+            if e.location == "shm":
+                footprint = _aligned(max(e.size, 1))
+                out["shm_bytes"] += footprint
+            else:
+                footprint = e.size
+                out["spilled_bytes"] += e.size
+                out["spilled_files"] += 1
+            out["object_bytes"] += e.size
+            if e.channel:
+                out["channel_bytes"] += footprint
+                out["channel_slots"] += 1
+            elif e.pinned:
+                out["pinned_bytes"] += footprint
+                out["pinned_objects"] += 1
+            if not e.sealed:
+                out["unsealed_bytes"] += footprint
+            if oid in self._deleted:
+                out["freed_pending_bytes"] += footprint
+        return out
+
     def object_summary(self, min_bytes: int, limit: int) -> List[List[Any]]:
         """[oid, size] pairs for sealed objects at/above min_bytes —
         piggybacked on heartbeats to feed the head's object directory
@@ -410,11 +453,14 @@ class StoreCore:
     def list_objects(self, limit: int = 1000) -> List[Dict[str, Any]]:
         """Object summaries for the state API (reference:
         GetObjectsInfo in node_manager.proto:405)."""
+        now = time.monotonic()
         out = []
         for oid, e in self.objects.items():
             out.append({"object_id": oid, "size": e.size,
                         "location": e.location, "sealed": e.sealed,
-                        "primary": e.primary, "pins": sum(e.pins.values())})
+                        "primary": e.primary, "pins": sum(e.pins.values()),
+                        "channel": e.channel, "freed": oid in self._deleted,
+                        "age_s": round(now - e.created_at, 3)})
             if len(out) >= limit:
                 break
         return out

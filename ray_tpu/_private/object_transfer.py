@@ -267,6 +267,13 @@ class ObjectTransferServer:
             oid = min(self._maps, key=lambda o: self._maps[o].last_used)
             self._maps.pop(oid).close()
 
+    def cache_stats(self) -> Dict[str, int]:
+        """Footprint of the across-pull disk mmap cache — one line of
+        the node's memory breakdown (`rtpu memory`)."""
+        with self._lock:
+            return {"files": len(self._maps),
+                    "bytes": sum(m.view.nbytes for m in self._maps.values())}
+
     def release(self, oid: str) -> None:
         """Pull finished (obj_unpin): drop any held disk mapping."""
         with self._lock:

@@ -20,12 +20,13 @@ reference handles this with WaitForRefRemoved chains instead).
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, List, Optional, Set, Tuple
+import time
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 
 class _Ref:
     __slots__ = ("local", "submitted", "borrowers", "owned", "freed",
-                 "lineage_pinned")
+                 "lineage_pinned", "call_site", "name", "created")
 
     def __init__(self, owned: bool):
         self.local = 0
@@ -34,6 +35,12 @@ class _Ref:
         self.owned = owned
         self.freed = False
         self.lineage_pinned = False  # keep TaskSpec for lineage re-execution
+        # memory accounting (`rtpu memory`): where the ref was minted
+        # (user frame of the put()/.remote() call), the producing
+        # task/actor-method name, and creation time for leak-TTL checks
+        self.call_site = ""
+        self.name = ""
+        self.created = time.monotonic()
 
 
 class ReferenceCounter:
@@ -129,6 +136,34 @@ class ReferenceCounter:
             self._on_release(oid)
 
     # ---- introspection -----------------------------------------------------
+
+    def set_meta(self, oid: str, call_site: str = "", name: str = "") -> None:
+        """Attach creation metadata to an existing ref (no-op for unknown
+        oids — the caller registers the ref first via add_local)."""
+        with self._lock:
+            ref = self._refs.get(oid)
+            if ref is None:
+                return
+            if call_site:
+                ref.call_site = call_site
+            if name:
+                ref.name = name
+
+    def summary(self) -> List[Dict[str, Any]]:
+        """One record per live ref — the worker half of `rtpu memory`
+        (reference: CoreWorker's ownership-table dump behind `ray
+        memory`).  Snapshot under the lock, dict-building outside it."""
+        with self._lock:
+            snap = [(oid, r.owned, r.local, r.submitted, len(r.borrowers),
+                     r.lineage_pinned, r.call_site, r.name, r.created)
+                    for oid, r in self._refs.items() if not r.freed]
+        now = time.monotonic()
+        return [{"oid": oid, "owned": owned, "local": local,
+                 "submitted": submitted, "borrowers": borrowers,
+                 "lineage_pinned": pinned, "call_site": cs, "name": name,
+                 "age_s": round(now - created, 3)}
+                for (oid, owned, local, submitted, borrowers, pinned,
+                     cs, name, created) in snap]
 
     def count(self, oid: str) -> int:
         with self._lock:

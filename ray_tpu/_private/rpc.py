@@ -31,6 +31,14 @@ _LEN = struct.Struct("<I")
 _MAX_FRAME = 1 << 30
 
 
+def is_loopback(host: Any) -> bool:
+    """Shared by the head's driver-callback classification and the
+    worker's bind-host pick — ONE definition, so the two sides can
+    never drift into classifying the same address differently."""
+    h = str(host)
+    return h.startswith("127.") or h in ("localhost", "::1")
+
+
 class RpcError(Exception):
     """Remote handler raised; message carries the remote traceback string."""
 

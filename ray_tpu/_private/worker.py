@@ -32,6 +32,7 @@ import contextvars
 import inspect
 import os
 import queue
+import sys
 import threading
 import time
 import traceback
@@ -59,7 +60,8 @@ from ray_tpu._private.streaming import (STREAMING, ObjectRefGenerator,
                                         StreamState)
 from ray_tpu._private import tracing
 from ray_tpu._private.rpc import (ConnectionLost, EventLoopThread, RpcClient,
-                                  RpcError, RpcHost, RpcServer, SyncRpcClient)
+                                  RpcError, RpcHost, RpcServer, SyncRpcClient,
+                                  is_loopback)
 from ray_tpu._private.task_spec import (ACTOR_CREATION_TASK, ACTOR_TASK,
                                         NORMAL_TASK, TaskSpec, WireArg)
 
@@ -82,6 +84,48 @@ _MAX_ACTOR_INFLIGHT = 1000
 
 _global_worker: Optional["CoreWorker"] = None
 _global_lock = threading.Lock()
+
+# root of the ray_tpu package: frames under it are framework internals,
+# the first frame OUTSIDE it is the user call-site recorded per ref
+# (trailing separator so a sibling dir sharing the prefix doesn't match)
+_PKG_DIR = os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))) + os.sep
+
+
+def _user_call_site() -> str:
+    """file:line:function of the first non-framework frame on this
+    thread's stack — the `rtpu memory` attribution for a put()/.remote()
+    minted ref.  A bounded frame walk (~1µs), gated by
+    memory_record_call_sites for hot paths that can't spare it."""
+    if not config.memory_record_call_sites:
+        return ""
+    try:
+        f = sys._getframe(2)
+        for _ in range(32):
+            if f is None:
+                return ""
+            fn = f.f_code.co_filename
+            if not fn.startswith(_PKG_DIR):
+                return (f"{os.path.basename(fn)}:{f.f_lineno}:"
+                        f"{f.f_code.co_name}")
+            f = f.f_back
+    except Exception:
+        pass
+    return ""
+
+
+def _live_channel_oids() -> List[str]:
+    """Channel-slot oids claimed by live compiled graphs in THIS process
+    (empty when the dag subsystem was never imported) — reported in the
+    memory summary so the head's channel-leak tripwire knows which store
+    slots are still legitimately owned."""
+    mod = sys.modules.get("ray_tpu.dag.execution")
+    if mod is None:
+        return []
+    try:
+        return list(mod.live_channel_oids())
+    except Exception:
+        return []
 
 
 def global_worker_or_none() -> Optional["CoreWorker"]:
@@ -331,15 +375,36 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
         self.head_addr = head_addr
         self.agent_addr = tuple(agent_addr)
         self._io = EventLoopThread(name=f"rt-io-{mode}")
-        self._server = RpcServer(self, "127.0.0.1", 0)
+        # pooled workers always co-locate with their agent, so loopback
+        # is the right bind for them — but a DRIVER under a REMOTE head
+        # must be dialable back (the head's memory aggregator joins its
+        # reference table, and borrowers dial owner_addr), so advertise
+        # the interface this machine routes to the head through
+        bind_host = "127.0.0.1"
+        if mode == MODE_DRIVER and not is_loopback(head_addr[0]):
+            import socket as _socket
+
+            try:
+                probe = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+                try:
+                    probe.connect((head_addr[0], head_addr[1] or 1))
+                    bind_host = probe.getsockname()[0]
+                finally:
+                    probe.close()
+            except OSError:
+                pass  # loopback + the head-side gap handling backstop
+        self._server = RpcServer(self, bind_host, 0)
         port = self._io.run(self._server.start())
-        self.address: Tuple[str, int] = ("127.0.0.1", port)
+        self.address: Tuple[str, int] = (bind_host, port)
         self.head = SyncRpcClient(head_addr[0], head_addr[1], self._io,
                                   label="head",
                                   retry_lost_s=config.gcs_reconnect_grace_s)
         self.agent = SyncRpcClient(agent_addr[0], agent_addr[1], self._io, label="agent")
         if not job_id:
-            job_id = self.head.call("register_job")["job_id"]
+            # driver_addr lets the head's memory aggregator call back
+            # into this driver's reference table (rtpu memory)
+            job_id = self.head.call(
+                "register_job", driver_addr=list(self.address))["job_id"]
         self.job_id = job_id
         if arena_path:
             self.plasma = PlasmaClient(arena_path, self.agent,
@@ -999,6 +1064,53 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             *[self.rpc_fetch_object(oid, wait=wait) for oid in oids])
         return {"results": dict(zip(oids, results))}
 
+    def memory_summary(self, limit: int = 0) -> Dict[str, Any]:
+        """This process's half of the cluster memory view: every live
+        owned/borrowed ref with pin state, borrower count, size, store
+        location, and creation call-site (reference: the per-worker
+        `GetCoreWorkerStats` dump behind `ray memory`).  Bounded: owned
+        refs sort largest-first and both lists cap at `limit`."""
+        limit = int(limit) or int(config.memory_summary_max_refs)
+        owned: List[Dict[str, Any]] = []
+        borrowed: List[Dict[str, Any]] = []
+        for r in self.rc.summary():
+            oid = r["oid"]
+            size = self._obj_sizes.get(oid, 0)
+            if oid in self._locations:
+                store = "plasma"
+            else:
+                e = self.memory.peek(oid)
+                if e is not None:
+                    if e.in_plasma:
+                        store = "plasma"
+                    elif e.error is not None:
+                        store = "error"
+                    else:
+                        store = "inline"
+                        if not size and e.raw is not None:
+                            size = len(e.raw)
+                elif self.memory.known(oid):
+                    store = "pending"
+                else:
+                    store = "remote"
+            r["size"] = size
+            r["store"] = store
+            (owned if r.pop("owned") else borrowed).append(r)
+        owned.sort(key=lambda x: -x["size"])
+        return {
+            "worker_id": self.worker_id, "node_id": self.node_id,
+            "kind": self.mode, "addr": list(self.address),
+            "num_owned": len(owned), "num_borrowed": len(borrowed),
+            "owned_bytes": sum(x["size"] for x in owned),
+            "truncated": max(0, len(owned) - limit)
+            + max(0, len(borrowed) - limit),
+            "owned": owned[:limit], "borrowed": borrowed[:limit],
+            "channels": _live_channel_oids(),
+        }
+
+    async def rpc_memory_summary(self, limit: int = 0):
+        return self.memory_summary(limit)
+
     async def rpc_task_ack(self, task_id: str):
         self._pending_acks.pop(task_id, None)
 
@@ -1062,7 +1174,9 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
         if ctx.refs:
             # the stored value embeds refs: pin them for the outer's lifetime
             self._containers[oid] = list(ctx.refs)
-        return ObjectRef(oid, owner_addr=self.address, node_addr=node_addr)
+        ref = ObjectRef(oid, owner_addr=self.address, node_addr=node_addr)
+        self.rc.set_meta(oid, call_site=_user_call_site(), name="put")
+        return ref
 
     # ------------------------------------------------------------------- get
 
@@ -1635,9 +1749,12 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             task.retries_left = 0
             self._streams[spec.task_id] = StreamState()
             refs.append(ObjectRefGenerator(self, spec.task_id))
+        call_site = _user_call_site()
         for oid in task.return_oids:
             self.memory.ensure(oid)
             refs.append(ObjectRef(oid, owner_addr=self.address))
+            self.rc.set_meta(oid, call_site=call_site,
+                             name=name or function_id[:8])
         self.record_task_event(
             spec.task_id, "SUBMITTED",
             name=name or function_id[:8], kind=NORMAL_TASK,
@@ -2654,9 +2771,11 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             task.retries_left = 0
             self._streams[spec.task_id] = StreamState()
             refs.append(ObjectRefGenerator(self, spec.task_id))
+        call_site = _user_call_site()
         for oid in task.return_oids:
             self.memory.ensure(oid)
             refs.append(ObjectRef(oid, owner_addr=self.address))
+            self.rc.set_meta(oid, call_site=call_site, name=method_name)
         try:
             self._post_to_loop(self._actor_enqueue, astate, task)
         except RuntimeError:

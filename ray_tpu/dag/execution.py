@@ -52,6 +52,29 @@ DAG_INFO_METHOD = "__rt_dag_node_info__"
 
 _INPUT_KEY = "__input__"
 
+# channel slots claimed by live (compiled, not-yet-torn-down) graphs in
+# this process, keyed by graph identity.  The worker's memory summary
+# reports them so the head's channel-leak tripwire can tell a slot a
+# running pipeline still owns from one a dead/teardown-skipped graph
+# left pinned in the store forever.
+_live_channels: Dict[int, List[str]] = {}
+_live_channels_lock = threading.Lock()
+
+
+def live_channel_oids() -> List[str]:
+    with _live_channels_lock:
+        return [oid for oids in _live_channels.values() for oid in oids]
+
+
+def _register_live_channels(graph_key: int, oids: List[str]) -> None:
+    with _live_channels_lock:
+        _live_channels[graph_key] = list(dict.fromkeys(oids))
+
+
+def _unregister_live_channels(graph_key: int) -> None:
+    with _live_channels_lock:
+        _live_channels.pop(graph_key, None)
+
 
 class _ArgRef:
     """Marker inside a step's arg template: replaced at loop runtime by
@@ -457,6 +480,7 @@ class CompiledGraph:
                            header=spec.header_wire())
                 self._created.append(
                     (tuple(spec.nodes[node_id]["agent"]), spec.oid))
+        _register_live_channels(id(self), [oid for _, oid in self._created])
 
         # 4. driver-side endpoints
         self._in_writer = ch.ChannelWriter(self._input_spec)
@@ -693,6 +717,9 @@ class CompiledGraph:
             if self._torn_down:
                 return
             self._torn_down = True
+        # this graph no longer claims its slots: if the destroys below
+        # fail, the accounting layer flags them leaked (correctly)
+        _unregister_live_channels(id(self))
         self._monitor_stop.set()
         timeout = (float(config.dag_teardown_timeout_s)
                    if timeout is None else timeout)

@@ -426,6 +426,7 @@ class Dataset:
         # data/logical.py (reference: _internal/logical/ + planner/)
         self._logical: List[logical.LogicalOp] = plan or []
         self._ops_cache: Optional[List[_Op]] = None
+        self._phys_cache: Optional[Tuple[List[_Op], List[Tuple[int, List[_Op]]]]] = None
         self._materialized: Optional[List[Any]] = None
         self._last_stats: Dict[str, Any] = {}
 
@@ -438,18 +439,38 @@ class Dataset:
         into one task-per-block chain).  Cached: the plan is immutable
         after construction (_chain builds a NEW Dataset)."""
         if self._ops_cache is None:
-            ops: List[_Op] = []
+            pre, segments = self._phys_plan()
+            ops = list(pre)
+            for _, seg_ops in segments:
+                ops.extend(seg_ops)
+            self._ops_cache = ops
+        return self._ops_cache
+
+    def _phys_plan(self) -> Tuple[List[_Op], List[Tuple[int, List[_Op]]]]:
+        """Physical plan split at limit nodes: (ops before the first
+        limit — run distributed, one task per launched block — and
+        [(limit, trailing ops), ...] segments applied driver-side to
+        the ≤limit rows that survive).  Non-empty segments switch
+        iter_blocks to the early-stopping executor that never launches
+        block tasks past the limit."""
+        if self._phys_cache is None:
+            pre: List[_Op] = []
+            segments: List[Tuple[int, List[_Op]]] = []
+            cur = pre
             for node in logical.optimize(self._logical):
                 if node.name == "fused_map":
-                    ops.extend(node.payload)
+                    cur.extend(node.payload)
+                elif node.name == "limit":
+                    segments.append((int(node.payload), []))
+                    cur = segments[-1][1]
                 else:
                     # fail loudly: a plan node the executor doesn't know
                     # must never silently vanish from execution
                     raise ValueError(
                         f"no physical execution for logical op "
                         f"{node.name!r}")
-            self._ops_cache = ops
-        return self._ops_cache
+            self._phys_cache = (pre, segments)
+        return self._phys_cache
 
     def _chain(self, op: _Op) -> "Dataset":
         return Dataset(self._block_refs,
@@ -502,22 +523,31 @@ class Dataset:
     def _has_actor_op(self) -> bool:
         return any(op.is_actor for op in self._ops)
 
-    def _make_pool(self) -> List[Any]:
+    def _make_pool(self, ops: Optional[List[_Op]] = None) -> List[Any]:
         """Actors for the chain's class UDFs, sized to the workload
         within the strategy's [min_size, max_size]."""
         import ray_tpu
 
-        compute = next((op.compute for op in self._ops
+        ops = self._ops if ops is None else ops
+        compute = next((op.compute for op in ops
                         if op.is_actor and op.compute), None) \
             or ActorPoolStrategy()
         n = min(compute.max_size,
                 max(compute.min_size, len(self._block_refs)))
         cls = ray_tpu.remote(_PoolMapWorker)
-        return [cls.remote(self._ops) for _ in builtins.range(n)]
+        return [cls.remote(ops) for _ in builtins.range(n)]
 
     def _execute(self) -> List[Any]:
         if self._materialized is None:
-            if self._has_actor_op():
+            if self._phys_plan()[1]:
+                # a limit in the plan: the early-stopping iterator
+                # bounds what reaches the driver to ≤limit rows, which
+                # then re-enter the store as fresh blocks
+                import ray_tpu
+
+                self._materialized = [ray_tpu.put(b)
+                                      for b in self.iter_blocks()]
+            elif self._has_actor_op():
                 import weakref
 
                 actors = self._make_pool()
@@ -549,8 +579,8 @@ class Dataset:
         lines = [f"Source[{len(self._block_refs)} blocks]"]
         if self._logical:
             lines.append("  logical:   " + logical.describe(self._logical))
-        fused: List[str] = []
-        for op in self._ops:
+
+        def _label(op: _Op) -> str:
             label = op.kind
             if op.is_actor:
                 compute = op.compute or ActorPoolStrategy()
@@ -559,11 +589,25 @@ class Dataset:
                           f"{getattr(op.fn, '__name__', 'cls')})")
             else:
                 label += f"({getattr(op.fn, '__name__', 'fn')})"
-            fused.append(label)
-        if fused:
-            lines.append("  optimized: Fused[" + " | ".join(fused) + "]"
-                         + (" per-block task" if not self._has_actor_op()
-                            else " on actor pool"))
+            return label
+
+        # mirror the executor's split: distributed ops, then each limit
+        # with its driver-side residual — a Limit in the plan must show
+        # up here, not silently fold into the fused chain
+        pre, segments = self._phys_plan()
+        parts: List[str] = []
+        if pre:
+            parts.append("Fused[" + " | ".join(_label(o) for o in pre) + "]"
+                         + (" on actor pool"
+                            if any(o.is_actor for o in pre)
+                            else " per-block task"))
+        for n, seg in segments:
+            parts.append(f"Limit[{n}]")
+            if seg:
+                parts.append("Fused[" + " | ".join(_label(o) for o in seg)
+                             + "] driver-side")
+        if parts:
+            lines.append("  optimized: " + " -> ".join(parts))
         return "\n".join(lines)
 
     def stats(self) -> Dict[str, Any]:
@@ -603,6 +647,11 @@ class Dataset:
                     yield tally(ray_tpu.get(ref, timeout=600))
                 return
             pending = list(self._block_refs)
+            pre_ops, segments = self._phys_plan()
+            if segments:
+                yield from self._iter_blocks_limited(
+                    pending, tally, pre_ops, segments)
+                return
             in_flight: List[Any] = []
             if self._has_actor_op():
                 actors = self._make_pool()
@@ -703,6 +752,82 @@ class Dataset:
                 launch(ci + 1)
                 launch(ci + 2)
 
+    def _iter_blocks_limited(self, refs: List[Any], tally,
+                             pre_ops: List[_Op],
+                             segments: List[Tuple[int, List[_Op]]]):
+        """Early-stopping executor for plans with a limit: launch block
+        tasks with a 2-deep lookahead and STOP launching once the first
+        limit's rows have been produced — source blocks past the limit
+        never become tasks (the limit-pushdown satellite).  The residual
+        segments (ops/limits after the first limit) apply driver-side to
+        the ≤limit surviving rows.  Class-UDF chains fall back to the
+        actor pool for the pre-limit ops, still consumed with the same
+        early stop."""
+        import ray_tpu
+
+        n1 = segments[0][0]
+        counters = [0] * len(segments)
+        produced = 0
+        # post-limit class UDFs run driver-side on the capped rows:
+        # instantiate them once here (the pool path would apply them
+        # remotely BEFORE the cap)
+        segments = [(lim, [_Op(op.kind,
+                               op.fn(*op.ctor_args, **op.ctor_kwargs),
+                               op.batch_size) if op.is_actor else op
+                           for op in ops])
+                    for lim, ops in segments]
+        use_actors = any(op.is_actor for op in pre_ops)
+        fn = _remote_fused() if pre_ops and not use_actors else None
+        actors = self._make_pool(pre_ops) if use_actors else None
+        in_flight: List[Any] = []
+        idx = 0
+        lookahead = 2 if not use_actors else 2 * len(actors)
+        while produced < n1 and (idx < len(refs) or in_flight):
+            while idx < len(refs) and len(in_flight) < lookahead:
+                ref = refs[idx]
+                if use_actors:
+                    ref = actors[idx % len(actors)].apply.remote(ref)
+                elif fn is not None:
+                    ref = fn.remote(ref, pre_ops)
+                in_flight.append(ref)
+                idx += 1
+            block = ray_tpu.get(in_flight.pop(0), timeout=600)
+            acc = BlockAccessor(block)
+            take_rows = min(acc.num_rows(), n1 - produced)
+            produced += take_rows
+            if take_rows <= 0:
+                continue
+            if take_rows < acc.num_rows():
+                block = acc.slice(0, take_rows)
+            block = self._apply_limit_suffix(block, segments, counters)
+            if BlockAccessor(block).num_rows() > 0:
+                yield tally(block)
+            if len(segments) > 1 and all(
+                    counters[i] >= segments[i][0]
+                    for i in builtins.range(1, len(segments))):
+                # every TRAILING limit is already full: rows still due
+                # under the first (larger) limit can only come out as
+                # empty blocks — stop launching/fetching now
+                break
+
+    @staticmethod
+    def _apply_limit_suffix(block, segments, counters):
+        """Ops after the first limit (and any further limits) run
+        driver-side: every row here already survived the first cap, so
+        the work is bounded by it."""
+        if segments[0][1]:
+            block = _apply_ops(block, segments[0][1])
+        for i in builtins.range(1, len(segments)):
+            lim, ops = segments[i]
+            acc = BlockAccessor(block)
+            take_rows = min(acc.num_rows(), lim - counters[i])
+            if take_rows < acc.num_rows():
+                block = acc.slice(0, take_rows)
+            counters[i] += take_rows
+            if ops and BlockAccessor(block).num_rows() > 0:
+                block = _apply_ops(block, ops)
+        return block
+
     def iter_rows(self) -> Iterator[dict]:
         for block in self.iter_blocks():
             yield from BlockAccessor(block).to_rows()
@@ -738,8 +863,11 @@ class Dataset:
         raise ValueError(batch_format)
 
     def take(self, n: int = 20) -> List[dict]:
+        """First n rows.  Routed through limit(n), so the executor stops
+        launching block tasks once n rows exist instead of streaming the
+        whole dataset at the driver."""
         out: List[dict] = []
-        for row in self.iter_rows():
+        for row in self.limit(n).iter_rows():
             out.append(row)
             if len(out) >= n:
                 break
@@ -861,9 +989,17 @@ class Dataset:
         return Dataset(refs)
 
     def limit(self, n: int) -> "Dataset":
-        import ray_tpu
-
-        return Dataset([ray_tpu.put(build_block(self.take(n)))])
+        """Lazy row cap: appends a ``limit`` node to the logical plan.
+        The LimitPushdown rule merges/hops it and the executor stops
+        launching block tasks once n rows are produced — no full
+        materialization on the driver (the former behavior)."""
+        if self._materialized is not None:
+            # plan already ran: cap the materialized blocks directly
+            # rather than re-launching the op chain over the sources
+            return Dataset(self._materialized,
+                           [logical.LogicalOp("limit", int(n))])
+        return Dataset(self._block_refs,
+                       self._logical + [logical.LogicalOp("limit", int(n))])
 
     # ---- splitting (train ingest) ----
 

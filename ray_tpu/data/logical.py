@@ -7,15 +7,21 @@ planner/planner.py): a Dataset accumulates LogicalOp nodes; before
 execution the plan runs through an ordered list of rewrite rules, and
 the physical executor consumes the rewritten plan.  Today's rules:
 
+  * LimitPushdown — adjacent ``limit`` nodes merge (min wins) and a
+    limit hops ahead of row-count-preserving per-row maps, so the
+    executor stops launching block tasks at the limit instead of
+    transforming rows it will drop (reference:
+    rules/limit_pushdown.py).
+
   * FuseMapOperators — adjacent per-row/per-batch transforms collapse
     into one ``fused_map`` node executed as a single task (or actor
     call) per block, the fusion the reference expresses in
     operator_fusion.py.
 
 The rule list is the extension seam: later rules (predicate pushdown,
-limit pushdown, exchange planning) append here without touching the
-Dataset surface.  The executor fails loudly on plan nodes it has no
-physical translation for, so a new rule cannot silently drop work.
+exchange planning) append here without touching the Dataset surface.
+The executor fails loudly on plan nodes it has no physical translation
+for, so a new rule cannot silently drop work.
 """
 
 from __future__ import annotations
@@ -41,6 +47,8 @@ class LogicalOp:
             inner = ", ".join(getattr(o.fn, "__name__", o.kind)
                               for o in self.payload)
             return f"FusedMap[{inner}]"
+        if self.name == "limit":
+            return f"Limit[{self.payload}]"
         if self.payload is not None and hasattr(self.payload, "kind"):
             fn = getattr(self.payload.fn, "__name__", "fn")
             return f"{self.name.title()}({fn})"
@@ -77,7 +85,43 @@ class FuseMapOperators(Rule):
         return out
 
 
-DEFAULT_RULES: List[Rule] = [FuseMapOperators()]
+class LimitPushdown(Rule):
+    """Merge adjacent ``limit`` nodes (min wins) and push a limit ahead
+    of a preceding per-row ``map`` — maps are 1:1 and order-preserving,
+    so limiting first is equivalent and spares transforming rows the
+    limit would drop.  Non-row-preserving ops (filter, flat_map,
+    map_batches) block the hop.  Runs before fusion so the map-likes
+    left adjacent after the hop still fuse."""
+
+    name = "limit_pushdown"
+
+    def apply(self, ops: List[LogicalOp]) -> List[LogicalOp]:
+        out = list(ops)
+        changed = True
+        while changed:
+            changed = False
+            i = 0
+            while i < len(out):
+                node = out[i]
+                if node.name != "limit" or i == 0:
+                    i += 1
+                    continue
+                prev = out[i - 1]
+                if prev.name == "limit":
+                    out[i - 1] = LogicalOp(
+                        "limit", min(prev.payload, node.payload))
+                    del out[i]
+                    changed = True
+                elif prev.name == "map":
+                    out[i - 1], out[i] = node, prev
+                    changed = True
+                    i += 1
+                else:
+                    i += 1
+        return out
+
+
+DEFAULT_RULES: List[Rule] = [LimitPushdown(), FuseMapOperators()]
 
 
 def optimize(ops: List[LogicalOp],

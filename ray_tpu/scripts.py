@@ -13,7 +13,8 @@ python/ray/util/state/state_cli.py).  Installed as `rtpu` via
   rtpu job submit [--address A] [--working-dir D] -- python train.py
   rtpu job status|logs|stop JOB_ID
   rtpu job list
-  rtpu summary tasks|actors|objects
+  rtpu summary [tasks|actors|objects]   # per-function aggregates + percentiles
+  rtpu memory [--top N] [--json]        # who owns the cluster's bytes + leaks
   rtpu timeline -o trace.json
   rtpu trace list [--limit N]
   rtpu trace get TRACE_ID [-o trace.json]
@@ -370,30 +371,154 @@ def cmd_job(args) -> int:
 # ----------------------------------------------------------- state/summary
 
 
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def _fmt_pct(p) -> str:
+    if not p:
+        return "-"
+    return (f"n={p['count']} p50={p['p50_ms']:.1f}ms "
+            f"p99={p['p99_ms']:.1f}ms")
+
+
 def cmd_summary(args) -> int:
-    import ray_tpu
-
-    addr = _resolve_address(args.address)
-    ray_tpu.init(address=f"{addr[0]}:{addr[1]}")
+    """Per-function task aggregates (state counts + queued/running
+    percentiles), actor rollups, and the per-node object-store byte
+    rollup — straight off the head, no driver attach."""
+    head, io = _head_client(_resolve_address(args.address))
     try:
-        from ray_tpu.util import state as state_api
-
-        if args.what == "tasks":
-            for name, states in state_api.summarize_tasks().items():
-                print(f"{name}: {states}")
-        elif args.what == "actors":
-            for a in state_api.list_actors():
-                print(f"{a['actor_id'][:12]}  {a['state']:<10} "
-                      f"{a.get('name', '')}")
-        elif args.what == "objects":
-            total = 0
-            for o in state_api.list_objects():
-                total += o["size"]
-                print(f"{o['object_id'][:16]}  {o['size']:>12}  "
-                      f"{o['location']}  node={o['node_id'][:12]}")
-            print(f"total bytes: {total}")
+        s = head.call("cluster_summary", timeout=30)
     finally:
-        ray_tpu.shutdown()
+        head.close()
+        io.stop()
+    if args.json:
+        print(json.dumps(s, indent=2, default=str))
+        return 0
+    if args.what in ("tasks", "all"):
+        print("tasks (per function):")
+        rows = sorted(s["tasks"].items(),
+                      key=lambda kv: -sum(kv[1]["states"].values()))
+        for name, row in rows:
+            states = " ".join(f"{k}={v}" for k, v in
+                              sorted(row["states"].items()))
+            print(f"  {name[:48]:<48} [{row['kind']}] {states}")
+            print(f"    queued:  {_fmt_pct(row.get('queued'))}")
+            print(f"    running: {_fmt_pct(row.get('running'))}")
+    if args.what in ("actors", "all"):
+        a = s["actors"]
+        print(f"actors: {a['num_actors']} total, by state "
+              f"{a['by_state']}")
+        for m, n in sorted(a["methods"].items(), key=lambda kv: -kv[1]):
+            print(f"  method {m[:48]:<48} calls={n}")
+    if args.what in ("objects", "all"):
+        o = s["objects"]
+        print(f"objects: {o['total_objects']} in store, "
+              f"arena={_fmt_bytes(o['total_arena_used'])} "
+              f"pinned={_fmt_bytes(o['total_pinned_bytes'])} "
+              f"spilled={_fmt_bytes(o['total_spilled_bytes'])} "
+              f"channels={_fmt_bytes(o['total_channel_bytes'])}")
+        for nid, m in o["nodes"].items():
+            print(f"  node {nid[:12]}: "
+                  f"arena {_fmt_bytes(m.get('arena_used'))}/"
+                  f"{_fmt_bytes(m.get('capacity'))}, "
+                  f"{m.get('num_objects', 0)} objects, "
+                  f"pinned {_fmt_bytes(m.get('pinned_bytes'))}, "
+                  f"spilled {_fmt_bytes(m.get('spilled_bytes'))} "
+                  f"({m.get('spilled_files', 0)} files)")
+    scan = s.get("last_leak_scan") or {}
+    stale = " (held from last complete scan — view currently partial)" \
+        if scan.get("partial") else ""
+    if scan.get("leaked_bytes"):
+        print(f"LEAKS: {_fmt_bytes(scan['leaked_bytes'])} flagged "
+              f"({scan.get('counts')}){stale} — run `rtpu memory` "
+              f"for detail")
+    elif scan.get("partial"):
+        print("LEAKS: detection suspended (partial ownership join) — "
+              "run `rtpu memory` for the gap list")
+    return 0
+
+
+def cmd_memory(args) -> int:
+    """`rtpu memory`: the joined cluster memory view — per-node byte
+    breakdowns, top objects by size with owner + creation call-site,
+    and the leak tripwire section (reference: `ray memory`)."""
+    head, io = _head_client(_resolve_address(args.address))
+    try:
+        v = head.call("memory_view", top_n=args.top, timeout=60)
+    finally:
+        head.close()
+        io.stop()
+    if args.json:
+        print(json.dumps(v, indent=2, default=str))
+        return 0
+    for nid, b in v["nodes"].items():
+        print(f"node {nid[:12]}: arena {_fmt_bytes(b.get('arena_used'))}"
+              f"/{_fmt_bytes(b.get('capacity'))} "
+              f"({b.get('num_objects', 0)} objects) | "
+              f"pinned {_fmt_bytes(b.get('pinned_bytes'))} | "
+              f"channels {b.get('channel_slots', 0)} slots "
+              f"{_fmt_bytes(b.get('channel_bytes'))} | "
+              f"spilled {_fmt_bytes(b.get('spilled_bytes'))} "
+              f"({b.get('spilled_files', 0)} files) | "
+              f"mmap cache {_fmt_bytes(b.get('mmap_cache_bytes'))} | "
+              f"{b.get('inflight_pulls', 0)} pulls in flight")
+    attributed, total = v["attributed_bytes"], v["store_object_bytes"]
+    pct = 100.0 * attributed / total if total else 100.0
+    print(f"{v['num_objects']} store objects, "
+          f"{_fmt_bytes(total)} payload bytes, "
+          f"{pct:.1f}% attributed to live owners")
+    if v.get("errors"):
+        # the gap list `rtpu summary` points operators at: who could
+        # not be joined and why the view is partial
+        print(f"PARTIAL VIEW — {len(v['errors'])} gap(s):")
+        for e in v["errors"]:
+            print(f"  {e}")
+    if v["objects"]:
+        print(f"top {len(v['objects'])} objects:")
+        print(f"  {'object':<20} {'size':>10} {'node':<12} {'loc':<5} "
+              f"{'pins':>4}  owner / call-site")
+        # "(no live owner)" is only trustworthy on a complete join — on
+        # a partial one the owner may simply be unreachable/truncated
+        no_owner = ("(owner unknown — partial view)"
+                    if (v.get("leaks") or {}).get("partial")
+                    else "(no live owner)")
+        for o in v["objects"]:
+            own = o.get("owner") or {}
+            who = (f"{own.get('kind', '?')}:"
+                   f"{own.get('worker_id', '')[:8]} "
+                   f"{own.get('name', '')} @ {own.get('call_site', '')}"
+                   if own else no_owner)
+            flags = "C" if o.get("channel") else ""
+            print(f"  {o['object_id'][:20]:<20} "
+                  f"{_fmt_bytes(o['size']):>10} {o['node_id'][:12]:<12} "
+                  f"{o['location']:<5} {o.get('pins', 0):>4}{flags:<1} {who}")
+    leaks = v["leaks"]
+    n_leaks = sum(len(leaks[k]) for k in
+                  ("dead_owner", "borrowed_ttl", "channel_slots"))
+    if n_leaks:
+        print(f"leaks ({_fmt_bytes(leaks['leaked_bytes'])} past "
+              f"{leaks['ttl_s']}s TTL"
+              + (", PARTIAL view" if leaks.get("partial") else "") + "):")
+        for e in leaks["dead_owner"]:
+            print(f"  dead-owner  {e['object_id'][:20]} "
+                  f"{_fmt_bytes(e['size'])} on {e['node_id'][:12]} "
+                  f"age={e['age_s']:.0f}s pins={e.get('pins', 0)}")
+        for e in leaks["borrowed_ttl"]:
+            print(f"  borrowed    {e['object_id'][:20]} "
+                  f"held by {e['worker_id'][:12]} age={e['age_s']:.0f}s")
+        for e in leaks["channel_slots"]:
+            print(f"  channel     {e['object_id'][:20]} "
+                  f"{_fmt_bytes(e['size'])} on {e['node_id'][:12]} "
+                  f"age={e['age_s']:.0f}s")
+    else:
+        print("no leaks flagged"
+              + (" (partial view)" if leaks.get("partial") else ""))
     return 0
 
 
@@ -548,10 +673,22 @@ def main(argv=None) -> int:
     jsub.add_parser("list")
     p.set_defaults(fn=cmd_job)
 
-    p = sub.add_parser("summary", help="task/actor/object summaries")
-    p.add_argument("what", choices=["tasks", "actors", "objects"])
+    p = sub.add_parser("summary", help="task/actor/object summaries "
+                                       "(state counts + percentiles)")
+    p.add_argument("what", nargs="?", default="all",
+                   choices=["all", "tasks", "actors", "objects"])
+    p.add_argument("--json", action="store_true")
     p.add_argument("--address", default="")
     p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("memory", help="cluster memory/object accounting "
+                                      "with owners, call-sites, and leaks")
+    p.add_argument("--top", type=int, default=0,
+                   help="objects in the top-N table "
+                        "(default: memory_view_top_n)")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--address", default="")
+    p.set_defaults(fn=cmd_memory)
 
     p = sub.add_parser("timeline", help="export a Chrome trace")
     p.add_argument("-o", "--output", default="timeline.json")

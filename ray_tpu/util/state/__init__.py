@@ -1,4 +1,6 @@
 from ray_tpu.util.state.api import (get_log, get_trace,  # noqa: F401
                                     list_actors, list_nodes, list_objects,
                                     list_placement_groups, list_tasks,
-                                    list_traces, summarize_tasks, timeline)
+                                    list_traces, memory_summary,
+                                    summarize_actors, summarize_objects,
+                                    summarize_tasks, timeline)

@@ -47,15 +47,35 @@ def list_placement_groups() -> List[Dict[str, Any]]:
     return _head().call("list_placement_groups")["placement_groups"]
 
 
-def summarize_tasks() -> Dict[str, Dict[str, int]]:
-    """Counts by task name and state (reference: `ray summary tasks`)."""
-    out: Dict[str, Dict[str, int]] = {}
-    for t in list_tasks(limit=100_000):
-        name = t.get("name", "?")
-        state = t.get("state", "?")
-        row = out.setdefault(name, {})
-        row[state] = row.get(state, 0) + 1
-    return out
+def summarize_tasks() -> Dict[str, Dict[str, Any]]:
+    """Per-function task aggregates off the head's task-event store
+    (reference: `ray summary tasks`): for each task/method name, state
+    counts plus queued (submitted→leased) and running (running→done)
+    p50/p99/mean percentiles — ``{name: {"kind", "states",
+    "queued": {p50_ms, p99_ms, ...} | None, "running": ...}}``."""
+    return _head().call("cluster_summary")["tasks"]
+
+
+def summarize_actors() -> Dict[str, Any]:
+    """Actor rollup (reference: `ray summary actors`): counts by state
+    plus per-method call counts from the task-event store."""
+    return _head().call("cluster_summary")["actors"]
+
+
+def summarize_objects() -> Dict[str, Any]:
+    """Cluster object-store rollup from the per-node heartbeat byte
+    breakdowns (reference: `ray summary objects`): totals for arena,
+    pinned, spilled and channel bytes plus the per-node breakdowns."""
+    return _head().call("cluster_summary")["objects"]
+
+
+def memory_summary(top_n: int = 0) -> Dict[str, Any]:
+    """The joined cluster memory view behind `rtpu memory` (reference:
+    `ray memory`): per-node byte breakdowns, top-N objects by size with
+    owner + creation call-site, per-owner ref counts, and the `leaks`
+    tripwire section (dead-owner pins, borrowed refs past TTL, orphaned
+    channel slots)."""
+    return _head().call("memory_view", top_n=top_n, timeout=60)
 
 
 def task_timeline_events(records) -> List[Dict[str, Any]]:

@@ -23,6 +23,83 @@ if "xla_force_host_platform_device_count" not in _flags:
 import pytest  # noqa: E402
 
 
+# --------------------------------------------------------- leak tripwire
+# Per-module snapshots of this process's thread and socket counts.  A
+# cluster that truly tears down returns both to baseline; a leak (an
+# EventLoopThread or RpcClient surviving shutdown) compounds module
+# over module.  The signature is a rising LOW-WATER mark: a module
+# snapshotted mid-teardown spikes high but the next quiet module drops
+# back, while a genuine leak lifts the floor of every later snapshot —
+# so compare window minima, not per-module deltas.
+
+_RESOURCE_HISTORY = []  # (module_name, threads, sockets)
+_LEAK_WINDOW = 5        # modules per comparison window
+_LEAK_FLOOR = 25        # min rise between window floors that trips
+
+
+def _count_threads_sockets():
+    import gc
+    import threading
+
+    # A shut-down cluster's event-loop socketpairs close on GC, not on
+    # shutdown(): dead drivers pile up until a gen-2 collection, whose
+    # period can exceed the comparison window — without this collect the
+    # floor rises on GC lag alone.  Truly pinned components (a global
+    # root holding a worker/loop) survive the collect and still trip.
+    gc.collect()
+    threads = threading.active_count()
+    sockets = 0
+    try:
+        fd_dir = "/proc/self/fd"
+        for fd in os.listdir(fd_dir):
+            try:
+                if os.readlink(os.path.join(fd_dir, fd)).startswith(
+                        "socket:"):
+                    sockets += 1
+            except OSError:
+                pass
+    except OSError:
+        pass
+    return threads, sockets
+
+
+def _monotonic_leak(history, window=_LEAK_WINDOW, floor=_LEAK_FLOOR):
+    """(kind, tail) when a resource's low-water mark over the last
+    `window` modules sits >= `floor` above its low-water mark over the
+    preceding `window` modules, else None.  Minima filter transient
+    spikes (a module snapshotted while its cluster is still closing);
+    a real leak raises every later module's floor.  Pure so the
+    detector itself is unit-testable."""
+    if len(history) < 2 * window:
+        return None
+    prev = history[-2 * window:-window]
+    tail = history[-window:]
+    for idx, kind in ((1, "threads"), (2, "sockets")):
+        if (min(h[idx] for h in tail)
+                - min(h[idx] for h in prev)) >= floor:
+            return kind, tail
+    return None
+
+
+@pytest.fixture(scope="module", autouse=True)
+def resource_leak_tripwire(request):
+    """Snapshot thread/socket counts after every test module and fail
+    on monotonic growth across cluster setup/teardown cycles."""
+    yield
+    threads, sockets = _count_threads_sockets()
+    _RESOURCE_HISTORY.append(
+        (request.module.__name__, threads, sockets))
+    hit = _monotonic_leak(_RESOURCE_HISTORY)
+    if hit is not None:
+        kind, tail = hit
+        detail = ", ".join(f"{name}={t}/{s}" for name, t, s in tail)
+        pytest.fail(
+            f"resource leak tripwire: the {kind} low-water mark rose "
+            f">= {_LEAK_FLOOR} across the last {_LEAK_WINDOW} test "
+            f"modules (module=threads/sockets: {detail}) — a cluster "
+            f"component is surviving shutdown()")
+
+
 def force_cpu_jax():
     """In-process override: this interpreter may already have the TPU
     plugin registered (sitecustomize); select CPU before first use."""

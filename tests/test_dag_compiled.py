@@ -345,8 +345,23 @@ def test_compiled_graph_teardown_idempotent_and_rejects_execute(
     with InputNode() as inp:
         dag = S.bind().step.bind(inp)
     g = dag.experimental_compile(use_channels=True)
+    # while the graph is live, its pinned slots are visible in the
+    # store breakdown AND claimed by this driver (not leak candidates)
+    from ray_tpu import api as _api
+
+    agent = _api._worker().agent
+    live = agent.call("node_memory", include_workers=False)["breakdown"]
+    assert live["channel_slots"] > 0 and live["channel_bytes"] > 0
     assert g.execute(1).get(timeout=60) == 1
     g.teardown()
+    # leak tripwire self-test (ISSUE 9 satellite): teardown must free
+    # every pinned channel slot — the accounting API is the assert
+    after = agent.call("node_memory", include_workers=False)["breakdown"]
+    assert after["channel_slots"] == 0, after
+    assert after["channel_bytes"] == 0, after
+    from ray_tpu.dag import execution as _exec
+
+    assert _exec.live_channel_oids() == []
     g.teardown()  # idempotent
     with pytest.raises(ray_tpu.RayError):
         g.execute(2)

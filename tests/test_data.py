@@ -380,3 +380,109 @@ def test_logical_plan_rewrite(cluster):
     assert len(optimized[0].payload) == 3  # one task runs all three
     plan = ds.explain()
     assert "logical:" in plan and "Fused[" in plan
+
+
+def test_limit_pushdown_rule_units():
+    """LimitPushdown: adjacent limits merge to the min; a limit hops
+    left past 1:1 maps (then merges) but never past filter/flat_map/
+    map_batches (reference: rules/limit_pushdown.py)."""
+    from ray_tpu.data.dataset import _Op
+    from ray_tpu.data.logical import LimitPushdown, LogicalOp
+
+    rule = LimitPushdown()
+
+    def names(ops):
+        return [(o.name, o.payload if o.name == "limit" else None)
+                for o in ops]
+
+    # merge: limit(10).limit(5) -> limit(5)
+    out = rule.apply([LogicalOp("limit", 10), LogicalOp("limit", 5)])
+    assert names(out) == [("limit", 5)]
+    # hop + merge: limit(10).map.limit(5) -> limit(5).map
+    out = rule.apply([LogicalOp("limit", 10),
+                      LogicalOp("map", _Op("map")),
+                      LogicalOp("limit", 5)])
+    assert names(out) == [("limit", 5), ("map", None)]
+    # filter blocks the hop
+    out = rule.apply([LogicalOp("filter", _Op("filter")),
+                      LogicalOp("limit", 7)])
+    assert names(out) == [("filter", None), ("limit", 7)]
+    # map_batches can change row counts: no hop
+    out = rule.apply([LogicalOp("map_batches", _Op("map_batches")),
+                      LogicalOp("limit", 3)])
+    assert names(out) == [("map_batches", None), ("limit", 3)]
+
+
+def test_limit_stops_launching_block_tasks(cluster, tmp_path):
+    """limit(n)/take(n) must stop LAUNCHING block tasks once n rows
+    exist instead of materializing the whole dataset on the driver —
+    each executed block task drops a marker file, and most of the 24
+    source blocks must never run (VERDICT weak #5)."""
+    marker_dir = str(tmp_path / "ran")
+    os.makedirs(marker_dir, exist_ok=True)
+
+    # a FILTER keeps the marker op distributed: LimitPushdown hops a
+    # limit over 1:1 maps, and a hopped marker would run driver-side on
+    # the already-capped rows — passing even if early-stop regressed
+    def touch(row):
+        import os as _os
+        import uuid as _uuid
+
+        open(_os.path.join(marker_dir, _uuid.uuid4().hex), "w").close()
+        return True
+
+    ds = rtd.range(240, num_blocks=24).filter(touch)
+    rows = ds.take(10)
+    assert [r["id"] for r in rows] == list(range(10))
+    # 10 rows fit in the first block; the 2-deep launch lookahead may
+    # run a couple more blocks, but never anything close to all 24
+    ran = len(os.listdir(marker_dir)) / 10  # 10 rows per block
+    assert ran <= 4, f"{ran} block tasks ran for a 10-row take"
+
+
+def test_trailing_limit_stops_launching(cluster, tmp_path):
+    """A satisfied TRAILING limit must also stop the executor — not
+    just the first one: limit(100).filter.limit(5) needs ~1 block of
+    input, not the 10 blocks the first limit would allow.  The FILTER
+    between the limits is load-bearing: without it LimitPushdown merges
+    them into one limit(5) and the trailing-limit path never runs."""
+    marker_dir = str(tmp_path / "ran")
+    os.makedirs(marker_dir, exist_ok=True)
+
+    def touch(row):
+        import os as _os
+        import uuid as _uuid
+
+        open(_os.path.join(marker_dir, _uuid.uuid4().hex), "w").close()
+        return True
+
+    ds = (rtd.range(240, num_blocks=24).filter(touch)
+          .limit(100).filter(lambda r: True).limit(5))
+    assert [r["id"] for r in ds.take_all()] == list(range(5))
+    ran = len(os.listdir(marker_dir)) / 10  # 10 rows per block
+    assert ran <= 4, f"{ran} block tasks ran for a trailing take(5)"
+
+
+def test_limit_semantics_across_ops(cluster):
+    """Row results match eager semantics whatever side of the limit the
+    ops land on."""
+    ds = rtd.range(100, num_blocks=10)
+    assert [r["id"] for r in ds.limit(7).take_all()] == list(range(7))
+    # map after limit (pushdown hops it): first 5 doubled
+    out = ds.limit(5).map(lambda r: {"id": r["id"] * 2}).take_all()
+    assert [r["id"] for r in out] == [0, 2, 4, 6, 8]
+    # filter before limit: first 4 even ids
+    out = ds.filter(lambda r: r["id"] % 2 == 0).limit(4).take_all()
+    assert [r["id"] for r in out] == [0, 2, 4, 6]
+    # limit then filter (filter stays after the cap)
+    out = ds.limit(10).filter(lambda r: r["id"] % 2 == 0).take_all()
+    assert [r["id"] for r in out] == [0, 2, 4, 6, 8]
+    # two limits separated by a filter: both caps enforced
+    out = (ds.limit(10).filter(lambda r: r["id"] < 8)
+           .limit(3).take_all())
+    assert [r["id"] for r in out] == [0, 1, 2]
+    # downstream exchange ops still execute a limited plan
+    assert ds.limit(6).count() == 6
+    assert sorted(r["id"] for r in
+                  ds.limit(6).random_shuffle(seed=1).take_all()) \
+        == list(range(6))

@@ -1,0 +1,396 @@
+"""Cluster memory & object accounting (ISSUE 9): worker reference
+summaries with call-sites, per-node store byte breakdowns, the head's
+joined /api/memory + /api/summary views, and the leak tripwires
+(dead-owner pins, borrowed refs past TTL, orphaned channel slots) with
+their ray_tpu_object_leaked_bytes gauge."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+
+MB = 1024 * 1024
+
+# fast tripwires for tests: scan twice a second, flag past 2s
+_ACCT_CONFIG = {"memory_scan_interval_s": 0.4, "object_leak_ttl_s": 2.0}
+
+
+@pytest.fixture(scope="module")
+def acct_cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=96 * MB,
+                 _system_config=dict(_ACCT_CONFIG))
+    try:
+        yield ray_tpu
+    finally:
+        ray_tpu.shutdown()
+
+
+def _head_metrics_port():
+    return ray_tpu.api._worker().head.call("metrics_port")["port"]
+
+
+def _scrape_head():
+    port = _head_metrics_port()
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+        return r.read().decode()
+
+
+def _leaked_bytes(kind: str) -> float:
+    needle = f'ray_tpu_object_leaked_bytes{{kind="{kind}"}}'
+    for ln in _scrape_head().splitlines():
+        if ln.startswith(needle):
+            return float(ln.rsplit(" ", 1)[1])
+    return -1.0  # gauge series not present yet
+
+
+def _wait(predicate, timeout=20.0, what=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"timed out waiting for {what or predicate}")
+
+
+# ----------------------------------------------------- multi-node e2e
+# Runs FIRST: it drives its own 2-node Cluster + driver, which must not
+# collide with the module-scoped single-node fixture below.
+
+
+def test_two_node_attribution_and_reconciliation():
+    """Acceptance on a live 2-node cluster: >=95% of arena bytes carry
+    function-level call-sites and every node's breakdown sums reconcile
+    with its store's occupancy gauge."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2, object_store_memory=64 * MB)
+    try:
+        ray_tpu.init(address=cluster.address,
+                     _system_config=dict(_ACCT_CONFIG))
+        cluster.wait_for_nodes(2)
+
+        @ray_tpu.remote
+        def produce(i):
+            import numpy as np
+
+            return np.full(2 * MB, i % 251, dtype=np.uint8)
+
+        # pin production to BOTH nodes (SPREAD is best-effort and can
+        # pack while the second node's workers are still spawning);
+        # returns are driver-owned but stored on the executing node
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+
+        nodes = [n["node_id"] for n in state.list_nodes()]
+        assert len(nodes) == 2, nodes
+        refs = [produce.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                nodes[i % 2], soft=False)).remote(i) for i in range(6)]
+        ray_tpu.get(refs, timeout=120)
+        local_put = ray_tpu.put(os.urandom(2 * MB))
+
+        def settled():
+            v = state.memory_summary(top_n=100)
+            return (len(v["nodes"]) == 2
+                    and v["store_object_bytes"] >= 10 * MB)
+
+        _wait(settled, timeout=30, what="2-node memory view")
+        v = state.memory_summary(top_n=100)
+        assert len(v["nodes"]) == 2
+        assert v["attributed_bytes"] / v["store_object_bytes"] >= 0.95
+        per_node_objects = {nid: 0 for nid in v["nodes"]}
+        for o in v["objects"]:
+            per_node_objects[o["node_id"]] += 1
+            if o["size"] >= 2 * MB:
+                assert o.get("owner"), o
+                assert o["owner"]["call_site"], o
+        # bytes landed on BOTH nodes (SPREAD) and each breakdown
+        # reconciles: aligned shm footprint == allocator occupancy
+        assert all(n > 0 for n in per_node_objects.values()), \
+            per_node_objects
+        for nid, b in v["nodes"].items():
+            assert b["shm_bytes"] == b["arena_used"], (nid, b)
+            assert b["arena_used"] + b["arena_free"] == b["capacity"]
+        assert not v["leaks"]["partial"]
+        del refs, local_put
+    finally:
+        try:
+            ray_tpu.shutdown()
+        finally:
+            cluster.shutdown()
+
+
+# ------------------------------------------------------- worker summaries
+
+
+def test_worker_summary_owned_refs_with_call_sites(acct_cluster):
+    """The owner's reference table records size, pin state and the USER
+    call-site for puts and task returns."""
+    @ray_tpu.remote
+    def produce():
+        import numpy as np
+
+        return np.zeros(1 * MB, dtype=np.uint8)
+
+    big = ray_tpu.put(b"z" * (2 * MB))          # plasma put
+    small = ray_tpu.put({"k": 1})               # inline put
+    ret = produce.remote()
+    ray_tpu.get(ret, timeout=60)
+    s = ray_tpu.api._worker().memory_summary()
+    assert s["kind"] == "driver" and s["num_owned"] >= 3
+    by_oid = {r["oid"]: r for r in s["owned"]}
+    me = os.path.basename(__file__)
+    r_big = by_oid[big.oid]
+    assert r_big["size"] >= 2 * MB and r_big["store"] == "plasma"
+    assert r_big["name"] == "put"
+    assert r_big["call_site"].startswith(me), r_big["call_site"]
+    assert r_big["local"] >= 1 and r_big["borrowers"] == 0
+    r_small = by_oid[small.oid]
+    assert r_small["store"] == "inline" and 0 < r_small["size"] < 1024
+    r_ret = by_oid[ret.oid]
+    assert r_ret["name"].endswith("produce")
+    assert r_ret["call_site"].startswith(me)
+    assert r_ret["size"] >= 1 * MB
+    del big, small, ret
+
+
+def test_memory_view_attributes_arena_bytes(acct_cluster):
+    """Acceptance: the joined view attributes >=95% of reported arena
+    bytes to owned refs with call-sites, and each node's breakdown
+    reconciles with the store's own occupancy gauge."""
+    refs = [ray_tpu.put(os.urandom(1 * MB)) for _ in range(6)]
+    v = state.memory_summary(top_n=100)
+    assert v["store_object_bytes"] >= 6 * MB
+    assert v["attributed_bytes"] / v["store_object_bytes"] >= 0.95
+    for nid, b in v["nodes"].items():
+        # aligned shm footprint == allocator occupancy, exactly
+        assert b["shm_bytes"] == b["arena_used"], (nid, b)
+        usage = ray_tpu.api._worker().agent.call("store_usage")
+        assert b["capacity"] == usage["capacity"]
+    top = v["objects"][0]
+    assert top["owner"] and top["owner"]["call_site"]
+    assert not v["leaks"]["partial"]
+    del refs
+
+
+def test_summarize_tasks_percentiles_and_actor_methods(acct_cluster):
+    @ray_tpu.remote
+    def quick(i):
+        return i
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    ray_tpu.get([quick.remote(i) for i in range(8)], timeout=60)
+    c = Counter.remote()
+    ray_tpu.get([c.incr.remote() for _ in range(3)], timeout=60)
+
+    def summary_ready():
+        tasks = state.summarize_tasks()
+        rows = [v for k, v in tasks.items() if k.endswith("quick")]
+        if not rows or not rows[0]["running"]:
+            return False
+        return rows[0]["running"]["count"] >= 8
+
+    _wait(summary_ready, what="task summary percentiles")
+    tasks = state.summarize_tasks()
+    row = next(v for k, v in tasks.items() if k.endswith("quick"))
+    assert row["kind"] == "task"
+    assert row["states"].get("FINISHED", 0) >= 8
+    assert 0 <= row["running"]["p50_ms"] <= row["running"]["p99_ms"]
+    assert row["queued"] and row["queued"]["count"] >= 1
+    actors = state.summarize_actors()
+    assert actors["by_state"].get("ALIVE", 0) >= 1
+    assert any(k.endswith("incr") and n >= 3
+               for k, n in actors["methods"].items())
+    objs = state.summarize_objects()
+    assert objs["total_arena_used"] >= 0 and "nodes" in objs
+    ray_tpu.kill(c)
+
+
+def test_http_memory_and_summary_endpoints(acct_cluster):
+    ref = ray_tpu.put(b"h" * (1 * MB))
+    port = _head_metrics_port()
+
+    def fetch(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+            assert r.headers["Content-Type"].startswith("application/json")
+            return json.loads(r.read())
+
+    mem = fetch("/api/memory?top=5")
+    assert mem["nodes"] and len(mem["objects"]) <= 5
+    assert "leaks" in mem and "attributed_bytes" in mem
+    summ = fetch("/api/summary")
+    assert set(summ) >= {"tasks", "actors", "objects", "last_leak_scan"}
+    del ref
+
+
+def test_cli_memory_and_summary(acct_cluster, capsys):
+    from ray_tpu import scripts
+
+    w = ray_tpu.api._worker()
+    addr = f"{w.head_addr[0]}:{w.head_addr[1]}"
+    ref = ray_tpu.put(os.urandom(3 * MB))
+    assert scripts.main(["memory", "--address", addr]) == 0
+    out = capsys.readouterr().out
+    assert "arena" in out and "attributed to live owners" in out
+    assert os.path.basename(__file__) in out  # call-site shown
+    assert scripts.main(["summary", "--address", addr]) == 0
+    out = capsys.readouterr().out
+    assert "tasks (per function):" in out and "objects:" in out
+    assert scripts.main(["memory", "--address", addr, "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert "leaks" in parsed
+    del ref
+
+
+# --------------------------------------------------------- leak tripwires
+
+
+def test_borrowed_ref_ttl_leak_flagged_then_cleared(acct_cluster):
+    """A deliberately held borrowed ref is flagged within one TTL
+    interval, and the gauge returns to 0 after release."""
+    @ray_tpu.remote
+    class Hoarder:
+        def __init__(self):
+            self.held = None
+
+        def hold(self, ref):
+            self.held = ref  # keeps the BORROWED ref alive forever
+            return True
+
+        def release(self):
+            self.held = None
+            import gc
+
+            gc.collect()
+            return True
+
+    h = Hoarder.remote()
+    payload = ray_tpu.put(os.urandom(1 * MB))
+    # pass the ref INSIDE a container so the actor deserializes and
+    # keeps it (a plain arg would be consumed by the call itself)
+    assert ray_tpu.get(h.hold.remote([payload]), timeout=60)
+
+    def flagged():
+        v = state.memory_summary()
+        return any(e["object_id"] == payload.oid
+                   for e in v["leaks"]["borrowed_ttl"])
+
+    _wait(flagged, timeout=30, what="borrowed-TTL leak flag")
+    _wait(lambda: _leaked_bytes("borrowed_ttl") > 0, timeout=20,
+          what="borrowed_ttl gauge > 0")
+    assert ray_tpu.get(h.release.remote(), timeout=60)
+    _wait(lambda: _leaked_bytes("borrowed_ttl") == 0, timeout=30,
+          what="borrowed_ttl gauge back to 0")
+    ray_tpu.kill(h)
+    del payload
+
+
+def test_channel_slot_leak_flagged_then_cleared(acct_cluster):
+    """A channel slot no live compiled graph claims (as after a skipped
+    teardown) is flagged, and destroying it clears the gauge."""
+    from ray_tpu.dag import channel as chmod
+
+    spec = chmod.ChannelSpec(oid="dagch-leaktest-slot", max_in_flight=2,
+                             slot_size=64 * 1024, n_readers=1,
+                             writer_node="n0", reader_nodes=["n0"],
+                             nodes={})
+    agent = ray_tpu.api._worker().agent
+    agent.call("channel_create", oid=spec.oid, size=spec.total_size(),
+               header=spec.header_wire())
+
+    def flagged():
+        v = state.memory_summary()
+        return any(e["object_id"] == spec.oid
+                   for e in v["leaks"]["channel_slots"])
+
+    _wait(flagged, timeout=30, what="channel-slot leak flag")
+    _wait(lambda: _leaked_bytes("channel_slot") > 0, timeout=20,
+          what="channel_slot gauge > 0")
+    agent.call("channel_destroy", oid=spec.oid)
+    _wait(lambda: _leaked_bytes("channel_slot") == 0, timeout=30,
+          what="channel_slot gauge back to 0")
+
+
+def test_dead_owner_leak_flagged_then_cleared(acct_cluster, tmp_path):
+    """A driver that exits without freeing its plasma put leaves
+    primary bytes no owner claims: flagged as dead_owner within a TTL,
+    gauge back to 0 once the bytes are freed."""
+    w = ray_tpu.api._worker()
+    addr = f"{w.head_addr[0]}:{w.head_addr[1]}"
+    oid_file = tmp_path / "leaked_oid"
+    script = f"""
+import os
+import ray_tpu
+ray_tpu.init(address={addr!r})
+ref = ray_tpu.put(os.urandom(2 * 1024 * 1024))
+open({str(oid_file)!r}, "w").write(ref.oid)
+os._exit(0)  # hard exit: no shutdown, no free — the leak
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    subprocess.run([sys.executable, "-c", script], check=True, env=env,
+                   timeout=120)
+    leaked_oid = oid_file.read_text().strip()
+
+    def flagged():
+        v = state.memory_summary()
+        return any(e["object_id"] == leaked_oid
+                   for e in v["leaks"]["dead_owner"])
+
+    _wait(flagged, timeout=40, what="dead-owner leak flag")
+    _wait(lambda: _leaked_bytes("dead_owner") > 0, timeout=20,
+          what="dead_owner gauge > 0")
+    # cleanup: free the orphaned bytes; the gauge must return to 0
+    w.agent.call("store_free", oids=[leaked_oid])
+    _wait(lambda: _leaked_bytes("dead_owner") == 0, timeout=30,
+          what="dead_owner gauge back to 0")
+
+
+# (the 2-node acceptance test lives at the TOP of this module so it
+# runs before the module-scoped single-node fixture is instantiated)
+
+
+# ------------------------------------------------- conftest tripwire unit
+
+
+def test_resource_leak_detector_units():
+    """The conftest leak detector trips only when a resource's
+    low-water mark rises across windows — transient teardown spikes
+    never trip it, compounding growth does."""
+    import conftest as cft
+
+    grow = [(f"m{i}", 10 + i * 10, 5) for i in range(10)]
+    hit = cft._monotonic_leak(grow, window=5, floor=25)
+    assert hit is not None and hit[0] == "threads"
+    # spikes over a flat baseline (a module snapshotted mid-teardown):
+    # the floor never moves, no trip — the exact false positive the
+    # per-module-delta rule had
+    spiky = [("a", 10, 19), ("b", 10, 21), ("c", 10, 26), ("d", 10, 28),
+             ("e", 10, 51), ("f", 10, 11), ("g", 10, 14), ("h", 10, 46),
+             ("i", 10, 12), ("j", 10, 63)]
+    assert cft._monotonic_leak(spiky, window=5, floor=25) is None
+    # slow creep stays under the floor
+    creep = [(f"m{i}", 10 + i, 5) for i in range(12)]
+    assert cft._monotonic_leak(creep, window=5, floor=25) is None
+    # sockets leak independently of threads
+    socks = [(f"m{i}", 10, 5 + i * 10) for i in range(10)]
+    assert cft._monotonic_leak(socks, window=5, floor=25)[0] == "sockets"
+    # short history never trips
+    assert cft._monotonic_leak(grow[:8], window=5, floor=25) is None

@@ -68,6 +68,26 @@ def _run(coro):
     asyncio.run(coro)
 
 
+async def _assert_no_pull_residue(*agents, deadline_s: float = 5.0):
+    """After pulls complete: zero pinned bytes (the puller's obj_unpin
+    oneway may still be in flight, hence the short wait), zero mmap-
+    cache residue for shm pulls, and a breakdown whose shm bucket
+    reconciles exactly with the allocator's occupancy."""
+    import time as _time
+
+    deadline = _time.monotonic() + deadline_s
+    while True:
+        bds = [ag.store.byte_breakdown() for ag in agents]
+        if all(bd["pinned_bytes"] == 0 for bd in bds):
+            break
+        if _time.monotonic() > deadline:
+            raise AssertionError(f"pinned bytes survived the pull: {bds}")
+        await asyncio.sleep(0.05)
+    for ag, bd in zip(agents, bds):
+        assert bd["shm_bytes"] == bd["arena_used"], bd
+        assert bd["pinned_objects"] == 0, bd
+
+
 class TestBulkPull:
     def test_shm_to_shm(self, tmp_path):
         async def main():
@@ -83,6 +103,11 @@ class TestBulkPull:
                 assert b.xfer_stats["bulk_pulls"] == 1
                 assert b.xfer_stats["rpc_pulls"] == 0
                 assert b.xfer_stats["bytes_in"] == len(payload)
+                # accounting tripwire (ISSUE 9 satellite): once the pull
+                # completes, no transfer pin or mmap-cache entry survives
+                # on either side, and each breakdown reconciles with the
+                # allocator's own occupancy gauge
+                await _assert_no_pull_residue(a, b)
             finally:
                 await _down(head, agents)
         _run(main())
@@ -101,6 +126,7 @@ class TestBulkPull:
                 assert r.get("ok"), r
                 assert b.store.objects["oid-big"].location == "disk"
                 assert _read_object(b, "oid-big", len(payload)) == payload
+                await _assert_no_pull_residue(a, b)
             finally:
                 await _down(head, agents)
         _run(main())

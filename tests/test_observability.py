@@ -238,7 +238,11 @@ def test_list_tasks_and_summary(cluster):
     assert t.get("running_ts") and t.get("finished_ts")
     summary = summarize_tasks()
     traced_rows = [v for k, v in summary.items() if k.endswith("traced")]
-    assert traced_rows and traced_rows[0].get("FINISHED", 0) >= 5
+    assert traced_rows and traced_rows[0]["states"].get("FINISHED", 0) >= 5
+    # grown to percentiles: the running-phase stats cover the 5 runs
+    running = traced_rows[0]["running"]
+    assert running and running["count"] >= 5
+    assert running["p50_ms"] <= running["p99_ms"] <= running["max_ms"]
 
 
 def test_failed_task_recorded(cluster):
@@ -331,7 +335,10 @@ def test_metric_names_documented_in_readme(cluster):
                m.dispatch_pump_depth_gauge, m.dag_channel_occupancy_gauge,
                m.serve_proxy_inflight_gauge, m.fault_tolerance_metrics,
                m.task_events_dropped_counter,
-               m.dispatch_batch_size_histogram):
+               m.dispatch_batch_size_histogram,
+               m.object_leaked_bytes_gauge,
+               m.memory_scan_partial_gauge,
+               m.object_store_breakdown_gauge):
         fn()
     with m.default_registry._lock:
         names |= set(m.default_registry._metrics)
@@ -371,7 +378,7 @@ def test_head_dashboard_spa(local_cluster):
     ct, js = fetch("/app.js")
     assert ct.startswith("application/javascript")
     for needle in ("api/snapshot", "sparkline", "Placement groups",
-                   "Traces"):
+                   "Traces", "Memory", "api/memory"):
         assert needle in js.decode()
 
     # live state lands in the snapshot the app renders from
