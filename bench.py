@@ -949,6 +949,110 @@ def _train_bench_loop(force_cpu=False):
          "params": n_params, "mfu_pct": round(100 * mfu, 2),
          "loss": float(loss)}))
 
+def _pipeline_bench_loop():
+    """MPMD pipeline bench body: runs in a plugin-free CPU subprocess
+    (its own in-process cluster + 2 stage actors), prints one JSON line.
+
+    Best-of alternating pairs per the slow-box protocol: each round
+    measures the single-program baseline THEN the 2-stage pipeline on
+    the same global batch, so drift hits both sides equally.  Reports
+    steady-state pp_tokens_per_s / pp_step_p99_ms / pipeline_bubble_pct
+    and the single-program rate for the honest comparison (on one host
+    the pipeline adds channel hops for no extra compute, so the ratio
+    gauges overhead; on real multi-chip topologies pp multiplies the
+    in-stage mesh instead)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.train.pipeline import TrainPipeline
+
+    cfg = LlamaConfig.tiny()
+    mb, m, seq, steps, pairs = 2, 4, 64, 8, 2
+    B = mb * m
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(B, seq),
+                          dtype=np.int32)
+
+    def measure_sp():
+        import jax
+
+        from ray_tpu.parallel.mesh import MeshSpec, make_mesh, shard_batch
+        from ray_tpu.train.gspmd import build_llama_train_state
+
+        mesh = make_mesh(MeshSpec(dp=-1), devices=jax.devices()[:1])
+        params, opt, step_fn, _ = build_llama_train_state(
+            cfg, mesh, batch_size=B, seq_len=seq)
+        toks = shard_batch(mesh, tokens)
+        for _ in range(3):
+            params, opt, loss = step_fn(params, opt, toks)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt, loss = step_fn(params, opt, toks)
+        float(loss)
+        return steps * B * seq / (time.perf_counter() - t0)
+
+    def measure_pp():
+        pipe = TrainPipeline(cfg, pp=2, microbatch_size=mb,
+                             num_microbatches=m, seq_len=seq,
+                             devices_per_stage=1, step_timeout=120.0)
+        try:
+            for _ in range(3):  # warm: stage jits + channel attach
+                pipe.step(tokens)
+            walls, bubbles = [], []
+            for _ in range(steps):
+                out = pipe.step(tokens)
+                walls.append(out["wall_s"])
+                bubbles.append(out["bubble_pct"])
+            rate = steps * B * seq / sum(walls)
+            return rate, walls, bubbles
+        finally:
+            pipe.teardown()
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    try:
+        sp_rates, pp_rates = [], []
+        all_walls, best_bubbles = [], []
+        for _ in range(pairs):
+            sp_rates.append(measure_sp())
+            rate, walls, bubbles = measure_pp()
+            if not pp_rates or rate > max(pp_rates):
+                best_bubbles = bubbles
+            pp_rates.append(rate)
+            all_walls.extend(walls)
+        all_walls.sort()
+        p99 = all_walls[min(len(all_walls) - 1,
+                            int(0.99 * len(all_walls)))] * 1000.0
+        print("PIPEJSON " + json.dumps({
+            "pp_tokens_per_s": round(max(pp_rates), 1),
+            "pp_step_p99_ms": round(p99, 2),
+            "pipeline_bubble_pct": round(
+                sorted(best_bubbles)[len(best_bubbles) // 2], 2),
+            "pp_single_program_tokens_per_s": round(max(sp_rates), 1),
+        }))
+    finally:
+        ray_tpu.shutdown()
+
+
+def bench_pipeline_subprocess():
+    """Launch the pipeline bench in a plugin-free CPU interpreter (the
+    pp stages are actor subprocesses of ITS cluster, so the phase is
+    tier-1-safe on CPU and never contends for the chip)."""
+    from __graft_entry__ import _clean_subprocess_env
+
+    env = _clean_subprocess_env(8)
+    proc = subprocess.run(
+        [sys.executable, "-S", os.path.join(REPO, "bench.py"),
+         "--pipeline-bench"], env=env, capture_output=True, text=True,
+        timeout=480, cwd=REPO)
+    for line in proc.stdout.splitlines():
+        if line.startswith("PIPEJSON "):
+            return json.loads(line[len("PIPEJSON "):])
+    raise RuntimeError(
+        f"pipeline bench rc={proc.returncode}: {proc.stderr[-400:]}")
+
+
 def _run_train_subprocess(extras, errors):
     """TPU attempt under a hard deadline, then plugin-free CPU fallback."""
     from __graft_entry__ import _clean_subprocess_env
@@ -1065,6 +1169,10 @@ def main():
     # retry keeps clients whole while the controller re-heals)
     phase("chaos_recovery", lambda: extras.update(bench_chaos_subprocess()))
 
+    # pipeline phase: CPU-only subprocess cluster (2 MPMD stages over
+    # channels vs the single-program baseline, best-of alternating pairs)
+    phase("pipeline", lambda: extras.update(bench_pipeline_subprocess()))
+
     # train runs AFTER shutdown so the chip is free for the subprocess
     _run_train_subprocess(extras, errors)
 
@@ -1081,6 +1189,9 @@ def main():
 if __name__ == "__main__":
     if "--train-bench" in sys.argv:
         _train_bench_loop(force_cpu="--cpu" in sys.argv)
+    elif "--pipeline-bench" in sys.argv:
+        sys.path.insert(0, REPO)
+        _pipeline_bench_loop()
     elif "--locality-bench" in sys.argv:
         sys.path.insert(0, REPO)
         _locality_bench()

@@ -419,6 +419,29 @@ def dispatch_batch_size_histogram() -> Histogram:
     return _dispatch_batch_hist
 
 
+_pipeline_metrics: Optional[Tuple[Gauge, Counter]] = None
+
+
+def pipeline_metrics() -> Tuple[Gauge, Counter]:
+    """Process-singleton MPMD pipeline instrumentation (driver-side,
+    set from per-stage loop reports each optimizer step):
+    ``ray_tpu_pipeline_bubble_pct`` — idle share of the step window,
+    labeled stage=<i> (that stage's idle %) plus stage=all (the whole
+    pipeline's bubble: 1 - Σbusy / (S·wall));
+    ``ray_tpu_pipeline_stage_busy_seconds_total`` — cumulative stage
+    compute seconds labeled stage + phase=fwd|bwd|opt (bwd includes the
+    recompute-forward)."""
+    global _pipeline_metrics
+    if _pipeline_metrics is None:
+        _pipeline_metrics = (
+            Gauge("ray_tpu_pipeline_bubble_pct",
+                  "pipeline idle percentage per stage and overall"),
+            Counter("ray_tpu_pipeline_stage_busy_seconds_total",
+                    "cumulative pipeline stage compute seconds by phase"),
+        )
+    return _pipeline_metrics
+
+
 _ft_metrics: Optional[Tuple[Counter, Counter, Counter]] = None
 
 

@@ -68,6 +68,13 @@ from ray_tpu._private.task_spec import (ACTOR_CREATION_TASK, ACTOR_TASK,
 MODE_DRIVER = "driver"
 MODE_WORKER = "worker"
 
+# MPMD pipeline-stage system methods (train/pipeline.py): named with the
+# "__rt_dag_" prefix so they ride the compiled-DAG dispatch branch in
+# _execute_inner (pinned exec loop, exempt from per-method state autosave,
+# never shadowed by ActorHandle attribute lookup)
+PIPELINE_EXEC_METHOD = "__rt_dag_pipeline_loop__"
+PIPELINE_CTL_METHOD = "__rt_dag_pipeline_ctl__"
+
 _TASK_PUSH_TIMEOUT = 7 * 86400.0  # tasks may legitimately run for days
 _WARM_LEASE_TTL_S = 0.2  # idle leases stay pooled this long before return
 _LOCALITY_DEFER_S = 1.0  # max time the pump holds a task back waiting
@@ -3364,6 +3371,20 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                     elif spec.method_name == _dag_exec.DAG_EXEC_METHOD:
                         value = _dag_exec.run_actor_loop(
                             self, self._actor_instance, *args)
+                    elif spec.method_name in (PIPELINE_EXEC_METHOD,
+                                              PIPELINE_CTL_METHOD):
+                        # MPMD pipeline stage loop / control ops
+                        # (train/pipeline.py): the loop pins this exec
+                        # thread for the whole training run, like the
+                        # compiled-DAG loop above
+                        from ray_tpu.train import pipeline as _pipe
+
+                        if spec.method_name == PIPELINE_EXEC_METHOD:
+                            value = _pipe.run_stage_loop(
+                                self, self._actor_instance, *args)
+                        else:
+                            value = _pipe.run_stage_ctl(
+                                self, self._actor_instance, *args)
                     else:
                         raise AttributeError(
                             f"unknown compiled-DAG system method "
@@ -3463,6 +3484,25 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             # a broken restore must not fail the (re)start — the actor
             # comes up fresh, which is what it did before this feature
             traceback.print_exc()
+
+    def persist_actor_state(self) -> bool:
+        """Unconditional ``__rt_save__`` snapshot of this worker's actor,
+        bypassing the per-method cadence — pinned loops (the MPMD
+        pipeline stage loop) call this at optimizer-step boundaries,
+        where ``_maybe_save_actor_state``'s after-each-method trigger
+        never fires.  Returns False when the actor has no save hook or
+        no durable storage root is configured."""
+        inst = self._actor_instance
+        spec = self._actor_creation_spec
+        if inst is None or not hasattr(inst, "__rt_save__") \
+                or spec is None or not spec.actor_id:
+            return False
+        with self._actor_state_save_lock:
+            ckpt = self._actor_state_checkpoint(spec.actor_id)
+            if ckpt is None:
+                return False
+            ckpt.save(inst.__rt_save__())
+        return True
 
     def _maybe_save_actor_state(self) -> None:
         """After a successful actor method: persist ``__rt_save__()``

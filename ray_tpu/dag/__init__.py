@@ -30,7 +30,11 @@ removed:
   :class:`~ray_tpu.dag.execution.CompiledDAGRef` whose ``.get()`` reads
   the output channel — zero task specs, scheduler visits, or object
   refs per call.  Remote readers get versions pushed over the bulk
-  transfer plane.  Errors serialize into channel versions and re-raise
+  transfer plane.  ``node.with_channel_options(max_in_flight=…,
+  buffer_size_bytes=…)`` overrides one edge's ring depth/payload
+  capacity (deep data edges + shallow control edges in one graph —
+  the MPMD training pipeline in ``train/pipeline.py`` rides this
+  sizing model).  Errors serialize into channel versions and re-raise
   from ``.get()``; actor death poisons the pipeline (bounded by
   ``dag_monitor_interval_s``) instead of hanging it; ``teardown()`` is
   synchronous and idempotent.

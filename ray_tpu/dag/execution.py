@@ -240,6 +240,77 @@ def run_actor_loop(worker, instance, plan: Dict[str, Any]) -> Dict[str, Any]:
 # --------------------------------------------------------------- driver side
 
 
+class ChannelHost:
+    """Driver-side owner of a set of channel slots spread over nodes:
+    allocates the writer-node slot + reader-node mirrors for each spec,
+    and provides fleet-wide poison/destroy for failure and teardown.
+
+    Shared by :class:`CompiledGraph` and the MPMD training pipeline
+    (train/pipeline.py) — both need identical slot lifecycle handling
+    (create on every involved node, poison on death, destroy on
+    teardown, pooled agent clients)."""
+
+    def __init__(self):
+        self._agent_clients: Dict[tuple, Any] = {}
+        self._created: List[Tuple[tuple, str]] = []
+
+    def agent(self, addr) -> Any:
+        from ray_tpu import api as _api
+        from ray_tpu._private.rpc import SyncRpcClient
+
+        addr = tuple(addr)
+        w = _api._worker()
+        if addr == tuple(w.agent_addr):
+            return w.agent
+        client = self._agent_clients.get(addr)
+        if client is None:
+            client = SyncRpcClient(addr[0], addr[1], w._io,
+                                   label=f"dag-agent-{addr[1]}")
+            self._agent_clients[addr] = client
+        return client
+
+    def create(self, spec: ch.ChannelSpec) -> None:
+        """Allocate the slot on the writer node and a mirror on every
+        distinct reader node."""
+        for node_id in dict.fromkeys([spec.writer_node]
+                                     + spec.reader_nodes):
+            agent = self.agent(spec.nodes[node_id]["agent"])
+            agent.call("channel_create", oid=spec.oid,
+                       size=spec.total_size(),
+                       header=spec.header_wire())
+            self._created.append(
+                (tuple(spec.nodes[node_id]["agent"]), spec.oid))
+
+    def oids(self) -> List[str]:
+        return [oid for _addr, oid in self._created]
+
+    def for_each_slot(self, fn) -> None:
+        for addr, oid in self._created:
+            try:
+                fn(self.agent(addr), oid)
+            except Exception:
+                pass
+
+    def poison_all(self, error_bytes: bytes = b"",
+                   close_only: bool = False) -> None:
+        self.for_each_slot(lambda agent, oid: agent.call(
+            "channel_poison", oid=oid, error=error_bytes,
+            close_only=close_only))
+
+    def destroy_all(self) -> None:
+        self.for_each_slot(lambda agent, oid: agent.call(
+            "channel_destroy", oid=oid))
+        self._created.clear()
+
+    def close(self) -> None:
+        for client in self._agent_clients.values():
+            try:
+                client.close()
+            except Exception:
+                pass
+        self._agent_clients.clear()
+
+
 class CompiledDAGRef:
     """Result handle for one ``execute()``: reads the output channel
     version instead of resolving an object ref.  ``get()`` may be
@@ -292,11 +363,10 @@ class CompiledGraph:
         self._next_seq = 1
         self._exec_started: Dict[int, float] = {}
         self._out_cache: Dict[int, Any] = {}
-        self._agent_clients: Dict[tuple, Any] = {}
+        self._channels = ChannelHost()
         self._monitor_stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
         self._in_writer: Optional[ch.ChannelWriter] = None
-        self._created: List[Tuple[tuple, str]] = []
         self._loop_refs: Dict[int, Any] = {}
         self._plan(root)
         try:
@@ -408,19 +478,7 @@ class CompiledGraph:
     # -------------------------------------------------------------- setup
 
     def _agent(self, addr) -> Any:
-        from ray_tpu import api as _api
-        from ray_tpu._private.rpc import SyncRpcClient
-
-        addr = tuple(addr)
-        w = _api._worker()
-        if addr == tuple(w.agent_addr):
-            return w.agent
-        client = self._agent_clients.get(addr)
-        if client is None:
-            client = SyncRpcClient(addr[0], addr[1], w._io,
-                                   label=f"dag-agent-{addr[1]}")
-            self._agent_clients[addr] = client
-        return client
+        return self._channels.agent(addr)
 
     def _setup(self, timeout: float) -> None:
         import ray_tpu
@@ -451,36 +509,39 @@ class CompiledGraph:
                                         "xfer_port": info["xfer_port"]}
                       for info in self._node_info.values()}
 
-        # 2. channel specs
-        def make_spec(name: str, writer_entity, reader_entities) -> ch.ChannelSpec:
+        # 2. channel specs (per-channel ring overrides from
+        #    node.with_channel_options win over the compile-wide sizes)
+        def make_spec(name: str, writer_entity, reader_entities,
+                      opts: Optional[Dict[str, int]] = None
+                      ) -> ch.ChannelSpec:
+            opts = opts or {}
             wnode = node_of(writer_entity)
             rnodes = [node_of(r) for r in reader_entities]
             involved = dict.fromkeys([wnode] + rnodes)
             return ch.ChannelSpec(
                 oid=f"dagch-{self._dag_id}-{name}",
-                max_in_flight=self._max_in_flight,
-                slot_size=self._buffer,
+                max_in_flight=int(opts.get("max_in_flight")
+                                  or self._max_in_flight),
+                slot_size=int(opts.get("buffer_size_bytes")
+                              or self._buffer),
                 n_readers=len(reader_entities),
                 writer_node=wnode, reader_nodes=rnodes,
                 nodes={nid: node_table[nid] for nid in involved})
 
-        self._input_spec = make_spec("in", "driver", self._input_readers)
+        self._input_spec = make_spec(
+            "in", "driver", self._input_readers,
+            getattr(self._input_node, "_channel_opts", None))
         self._out_specs: Dict[int, ch.ChannelSpec] = {}
         for nid, readers in self._channel_readers.items():
+            node = next(n for n in self._method_nodes if id(n) == nid)
             self._out_specs[nid] = make_spec(
-                self._node_key[nid], id_to_actor(nid, self), readers)
+                self._node_key[nid], id_to_actor(nid, self), readers,
+                node._channel_opts)
 
         # 3. allocate slots (writer node) and mirrors (reader nodes)
         for spec in [self._input_spec] + list(self._out_specs.values()):
-            for node_id in dict.fromkeys([spec.writer_node]
-                                         + spec.reader_nodes):
-                agent = self._agent(spec.nodes[node_id]["agent"])
-                agent.call("channel_create", oid=spec.oid,
-                           size=spec.total_size(),
-                           header=spec.header_wire())
-                self._created.append(
-                    (tuple(spec.nodes[node_id]["agent"]), spec.oid))
-        _register_live_channels(id(self), [oid for _, oid in self._created])
+            self._channels.create(spec)
+        _register_live_channels(id(self), self._channels.oids())
 
         # 4. driver-side endpoints
         self._in_writer = ch.ChannelWriter(self._input_spec)
@@ -693,16 +754,7 @@ class CompiledGraph:
         if self._error is not None:
             return
         self._error = error
-        err_bytes = ch.pickle_error(error)
-        self._for_each_slot(lambda agent, oid: agent.call(
-            "channel_poison", oid=oid, error=err_bytes))
-
-    def _for_each_slot(self, fn) -> None:
-        for addr, oid in self._created:
-            try:
-                fn(self._agent(addr), oid)
-            except Exception:
-                pass
+        self._channels.poison_all(ch.pickle_error(error))
 
     # ------------------------------------------------------------- teardown
 
@@ -725,8 +777,7 @@ class CompiledGraph:
                    if timeout is None else timeout)
         deadline = time.monotonic() + timeout
         # 1. wake every loop: close all channels everywhere
-        self._for_each_slot(lambda agent, oid: agent.call(
-            "channel_poison", oid=oid, error=b"", close_only=True))
+        self._channels.poison_all(close_only=True)
         # 2. loops drain and return; a wedged loop is force-killed so
         #    teardown stays bounded
         refs = list(self._loop_refs.values())
@@ -758,16 +809,10 @@ class CompiledGraph:
                 time.sleep(0.05)
         self._actors.clear()
         # 4. free the pinned slots
-        self._for_each_slot(lambda agent, oid: agent.call(
-            "channel_destroy", oid=oid))
+        self._channels.destroy_all()
         if self._in_writer is not None:
             self._in_writer.detach()
-        for client in self._agent_clients.values():
-            try:
-                client.close()
-            except Exception:
-                pass
-        self._agent_clients.clear()
+        self._channels.close()
         if self._monitor is not None \
                 and self._monitor is not threading.current_thread():
             self._monitor.join(timeout=1.0)

@@ -45,6 +45,28 @@ class DAGNode:
     def __init__(self, args: tuple = (), kwargs: Optional[dict] = None):
         self._bound_args = args
         self._bound_kwargs = kwargs or {}
+        self._channel_opts: Dict[str, int] = {}
+
+    def with_channel_options(self, *, max_in_flight: Optional[int] = None,
+                             buffer_size_bytes: Optional[int] = None
+                             ) -> "DAGNode":
+        """Per-channel ring overrides for channel-compiled execution.
+
+        On a ClassMethodNode this sizes the node's OUTPUT channel; on an
+        InputNode, the driver's input channel.  Unset fields inherit the
+        compile-wide ``max_in_flight`` / ``buffer_size_bytes`` — so one
+        deep edge (e.g. pipeline activations) can coexist with shallow
+        control edges without raising the global ring size.  Returns
+        ``self`` for chaining; ignored by dynamic execution."""
+        if max_in_flight is not None:
+            if max_in_flight < 1:
+                raise ValueError("max_in_flight must be >= 1")
+            self._channel_opts["max_in_flight"] = int(max_in_flight)
+        if buffer_size_bytes is not None:
+            if buffer_size_bytes < 1:
+                raise ValueError("buffer_size_bytes must be >= 1")
+            self._channel_opts["buffer_size_bytes"] = int(buffer_size_bytes)
+        return self
 
     # ------------------------------------------------------------- traversal
 

@@ -197,6 +197,50 @@ class LlamaModel(nn.Module):
         return logits
 
 
+class LlamaStage(nn.Module):
+    """A contiguous layer range of :class:`LlamaModel` for MPMD pipeline
+    parallelism: stage 0 owns the embedding, the last stage owns the
+    final norm + lm_head, and every stage owns ``layers[start:end)``.
+
+    Submodule names match LlamaModel exactly (``embed``, ``layer_i``,
+    ``final_norm``, ``lm_head``), so a full-model checkpoint slices into
+    per-stage trees (see train/pipeline.py slice_params_for_stage) and
+    ``llama_param_rules`` applies unchanged.  Input is tokens [B, S] for
+    the first stage and activations [B, S, D] otherwise; output is
+    activations for non-last stages and logits for the last.
+    """
+
+    cfg: LlamaConfig
+    start: int
+    end: int            # exclusive layer bound
+    first: bool = False  # embed tokens
+    last: bool = False   # final_norm + lm_head
+    kernel: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        if self.first:
+            x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="embed")(x)
+            seq_len = x.shape[1]
+            positions = jnp.broadcast_to(jnp.arange(seq_len),
+                                         x.shape[:2])
+        else:
+            positions = jnp.broadcast_to(jnp.arange(x.shape[1]),
+                                         x.shape[:2])
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(Block, prevent_cse=False)
+        for i in range(self.start, self.end):
+            x = block_cls(cfg, self.kernel, name=f"layer_{i}")(x, positions)
+        if self.last:
+            x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+            x = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="lm_head")(x)
+        return x
+
+
 def llama_param_rules() -> Dict[str, Any]:
     """PartitionSpec rules by parameter-path substring.
 

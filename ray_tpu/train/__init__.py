@@ -16,9 +16,12 @@ from ray_tpu.train.session import (TrainContext, get_context, report,
 from ray_tpu.train.trainer import (JaxTrainer, Result, RunConfig,
                                    ScalingConfig, TrainingFailedError)
 from ray_tpu.train.worker_group import WorkerGroup
+from ray_tpu.train.pipeline import (PipelineError, TrainPipeline,
+                                    one_f_one_b, partition_layers)
 
 __all__ = ["JaxTrainer", "ScalingConfig", "RunConfig", "Result",
            "TrainingFailedError", "WorkerGroup", "TrainContext",
            "get_context", "report", "get_checkpoint", "get_dataset_shard",
            "save_checkpoint", "restore_checkpoint", "CheckpointManager",
-           "StorageContext"]
+           "StorageContext", "TrainPipeline", "PipelineError",
+           "partition_layers", "one_f_one_b"]

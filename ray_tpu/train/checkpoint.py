@@ -240,6 +240,30 @@ class ActorStateCheckpoint:
             self.storage.remove(self._rel(old))
         return name
 
+    def entry_names(self) -> List[str]:
+        """Retained snapshot names, oldest first."""
+        return list(self._entries)
+
+    def load_entry(self, name: str) -> Any:
+        """One specific retained snapshot's state, or None when the blob
+        is missing/unreadable.  The pipeline's restart protocol uses this
+        to roll every stage back to a COMMON step: after a mid-step
+        death, stages can hold different latest snapshots (the drained
+        last stage saves step t+1 before upstream stages finish it), so
+        recovery enumerates entries and restores the newest step present
+        on every stage rather than each stage's own latest."""
+        import cloudpickle
+
+        if name not in self._entries:
+            return None
+        blob = self.storage.read_bytes(self._rel(name))
+        if blob is None:
+            return None
+        try:
+            return cloudpickle.loads(blob)
+        except Exception:
+            return None
+
     def load_latest(self) -> Any:
         """The newest readable snapshot's state, or None when the actor
         has never saved (falling back through older snapshots if the

@@ -66,6 +66,126 @@ def build_llama_train_state(cfg, mesh, rng_seed: int = 0,
     return params, opt_state, step_fn, model
 
 
+def build_llama_stage_state(cfg, mesh, layer_range, *, first: bool,
+                            last: bool, microbatch_size: int, seq_len: int,
+                            num_microbatches: int, rng_seed: int = 0,
+                            learning_rate: float = 3e-4,
+                            attention_kernel: Optional[Callable] = None):
+    """Init one MPMD pipeline stage: sharded (params, opt_state) on the
+    IN-STAGE mesh (fsdp/sp/tp — ``pp`` multiplies this layout instead of
+    replacing it) plus the jitted stage functions the 1F1B loop replays.
+
+    Returns a dict:
+      params, opt_state           sharded stage subtree + adamw state
+      fwd(p, x) -> y              stage forward (None for the last stage,
+                                  whose forward fuses into the loss bwd)
+      bwd(p, x, gy) -> (gp, gx)   recompute-backward: re-runs the stage
+                                  forward inside the vjp (same FLOP trade
+                                  as cfg.remat) so only the stage INPUT is
+                                  kept resident per in-flight microbatch
+      loss_bwd(p, x, tokens) -> (loss, gp[, gx])   last stage only
+      opt_step(p, o, acc) -> (p, o)   adamw on accumulated grads / m
+      accum(acc, g) -> acc        donating grad accumulator
+      zero_grads(p) -> acc        fresh accumulator
+      shard_value(x) -> x         device_put a microbatch onto the mesh
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models.llama import (LlamaStage, causal_lm_loss,
+                                      llama_param_rules)
+    from ray_tpu.parallel.mesh import shard_batch, shard_params
+
+    if attention_kernel is None and mesh.shape.get("sp", 1) > 1:
+        from ray_tpu.ops.ring_attention import make_ring_attention
+
+        attention_kernel = make_ring_attention(mesh)
+    start, end = layer_range
+    model = LlamaStage(cfg, start=start, end=end, first=first, last=last,
+                       kernel=attention_kernel)
+    rng = jax.random.PRNGKey(rng_seed)
+    if first:
+        sample = jnp.zeros((microbatch_size, seq_len), dtype=jnp.int32)
+    else:
+        sample = jnp.zeros((microbatch_size, seq_len, cfg.dim),
+                           dtype=cfg.dtype)
+    scale = 1.0 / float(num_microbatches)
+    from functools import partial
+
+    with mesh:
+        params = jax.jit(lambda r: model.init(r, sample))(rng)["params"]
+        params = shard_params(mesh, params, llama_param_rules())
+        tx = optax.adamw(learning_rate)
+        opt_state = jax.jit(tx.init)(params)
+
+        def apply_fn(p, x):
+            return model.apply({"params": p}, x)
+
+        fwd = None if last else jax.jit(apply_fn)
+
+        loss_bwd = None
+        bwd = None
+        if last:
+            def loss_fn(p, x, tokens):
+                return causal_lm_loss(apply_fn(p, x), tokens)
+
+            if first:  # degenerate pp=1 stage: tokens in, no gx out
+                @jax.jit
+                def loss_bwd(p, x, tokens):
+                    loss, gp = jax.value_and_grad(loss_fn)(p, x, tokens)
+                    return loss, gp
+            else:
+                @jax.jit
+                def loss_bwd(p, x, tokens):
+                    loss, (gp, gx) = jax.value_and_grad(
+                        loss_fn, argnums=(0, 1))(p, x, tokens)
+                    return loss, gp, gx
+        elif first:
+            @jax.jit
+            def bwd(p, x, gy):
+                _, vjp = jax.vjp(lambda p_: apply_fn(p_, x), p)
+                (gp,) = vjp(gy)
+                return gp, None
+        else:
+            @jax.jit
+            def bwd(p, x, gy):
+                _, vjp = jax.vjp(apply_fn, p, x)
+                return vjp(gy)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def accum(acc, g):
+            return jax.tree_util.tree_map(jnp.add, acc, g)
+
+        zero_grads = jax.jit(
+            lambda p: jax.tree_util.tree_map(jnp.zeros_like, p))
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def opt_step(p, o, acc):
+            g = jax.tree_util.tree_map(lambda a: a * scale, acc)
+            updates, o = tx.update(g, o, p)
+            p = optax.apply_updates(p, updates)
+            return p, o
+
+    def run_in_mesh(fn):
+        def wrapped(*args):
+            with mesh:
+                return fn(*args)
+        return wrapped
+
+    return {
+        "params": params, "opt_state": opt_state,
+        "fwd": run_in_mesh(fwd) if fwd is not None else None,
+        "bwd": run_in_mesh(bwd) if bwd is not None else None,
+        "loss_bwd": run_in_mesh(loss_bwd) if loss_bwd is not None else None,
+        "opt_step": run_in_mesh(opt_step),
+        "accum": run_in_mesh(accum),
+        "zero_grads": run_in_mesh(zero_grads),
+        "shard_value": lambda x: shard_batch(mesh, x),
+        "model": model, "mesh": mesh,
+    }
+
+
 def param_count(params) -> int:
     import jax
 

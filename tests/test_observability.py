@@ -338,7 +338,8 @@ def test_metric_names_documented_in_readme(cluster):
                m.dispatch_batch_size_histogram,
                m.object_leaked_bytes_gauge,
                m.memory_scan_partial_gauge,
-               m.object_store_breakdown_gauge):
+               m.object_store_breakdown_gauge,
+               m.pipeline_metrics):
         fn()
     with m.default_registry._lock:
         names |= set(m.default_registry._metrics)
