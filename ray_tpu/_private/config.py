@@ -151,6 +151,24 @@ _def("actor_state_keep", 2)
 # one it picked died mid-flight
 _def("serve_replica_health_timeout_s", 120.0)
 _def("serve_dead_replica_retries", 3)
+# --- LLM serving tier (see serve/llm.py) -------------------------------------
+_def("llm_page_size", 16)           # KV-cache tokens per page
+_def("llm_kv_pages", 0)             # pages per replica; 0 = sized so
+# max_batch sequences can run at max_seq_len simultaneously
+_def("llm_max_batch_size", 32)      # decode lanes per engine step
+_def("llm_prefill_chunk", 64)       # prompt tokens prefetched per step —
+# bounds how long one long prompt can stall in-flight decodes
+_def("llm_prefill_lanes", 8)        # sequences prefilling one chunk each
+# per step (batched prefill: admitting N streams costs N/lanes steps)
+_def("llm_stream_flush_tokens", 4)  # tokens coalesced per stream item
+# after the first (the first token flushes immediately for TTFT); each
+# item costs a stream push + a ref resolution + an SSE chunk, so this
+# is the per-token transport amortizer
+_def("llm_admission_queue", 256)    # queued sequences before 503 shed
+_def("llm_detach_grace_s", 2.0)     # KV pages survive a vanished consumer
+# this long (the re-attach window for proxy resume) before recycling
+_def("llm_done_seq_ttl_s", 30.0)    # finished sequences replayable (by
+# request_id) this long for duplicate/late retries
 # --- distributed tracing (see _private/tracing.py) ---------------------------
 _def("tracing_enabled", True)
 _def("trace_sampling_ratio", 1.0)      # root-span sampling probability

@@ -213,6 +213,32 @@ class MetricsRegistry:
         with self._lock:
             return bool(self._metrics)
 
+    def foreign_sample_sum(self, name: str) -> Optional[float]:
+        """Sum a gauge/counter family's sample values across the pushed
+        worker snapshots (None when no pusher reports it).  Cheap line
+        scan over the cached exposition texts — how the node agent folds
+        worker-process signals (the LLM replica's queue depth and
+        tokens-per-step) into its heartbeat gauge summary without a
+        side-channel RPC."""
+        with self._lock:
+            now = time.monotonic()
+            texts = [t for t, ts in self._foreign.values()
+                     if now - ts < self.foreign_ttl_s]
+        total, found = 0.0, False
+        for text in texts:
+            for ln in text.splitlines():
+                if not ln.startswith(name) or ln.startswith("#"):
+                    continue
+                rest = ln[len(name):]
+                if rest[:1] not in ("{", " "):
+                    continue  # longer name sharing the prefix
+                try:
+                    total += float(ln.rsplit(" ", 1)[1])
+                    found = True
+                except (ValueError, IndexError):
+                    pass
+        return total if found else None
+
 
 def _merge_families(lines: List[str]) -> List[str]:
     """Merge exposition lines from several sources into one valid text
@@ -543,6 +569,46 @@ def serve_request_latency_histogram() -> Histogram:
             boundaries=[0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                         0.25, 0.5, 1, 2.5, 5, 10, 60])
     return _serve_request_latency
+
+
+_llm_metrics: Optional[Tuple[Counter, Gauge, Gauge, Histogram,
+                             Gauge, Gauge]] = None
+
+
+def llm_metrics() -> Tuple[Counter, Gauge, Gauge, Histogram, Gauge, Gauge]:
+    """Process-singleton LLM serving-tier metrics (serve/llm.py, set by
+    the replica engine each decode step):
+    ``ray_tpu_llm_tokens_total`` — tokens processed, labeled
+    phase=prefill|decode (decode rate IS the serving throughput);
+    ``ray_tpu_llm_kv_pages`` — paged KV-cache pages by state=used|free
+    (used pinned at capacity + queue depth rising = scale out);
+    ``ray_tpu_llm_batch_size`` — decode lanes in the last engine step;
+    ``ray_tpu_llm_ttft_seconds`` — submit-to-first-token latency
+    (admission queueing + chunked prefill, the serving SLO histogram);
+    ``ray_tpu_llm_queue_depth`` — sequences waiting in the admission
+    queue; ``ray_tpu_llm_tokens_per_step`` — tokens the last engine
+    step processed (prefill chunk + decode lanes).  The queue/step
+    gauges also ride the agent heartbeat into the head time-series ring
+    (``rtpu status --watch`` serving-pressure pane)."""
+    global _llm_metrics
+    if _llm_metrics is None:
+        _llm_metrics = (
+            Counter("ray_tpu_llm_tokens_total",
+                    "LLM tokens processed by phase (prefill|decode)"),
+            Gauge("ray_tpu_llm_kv_pages",
+                  "paged KV-cache pages by state (used|free)"),
+            Gauge("ray_tpu_llm_batch_size",
+                  "decode lanes in the last continuous-batching step"),
+            Histogram("ray_tpu_llm_ttft_seconds",
+                      "LLM time-to-first-token (submit to first emit)",
+                      boundaries=[0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                                  0.5, 1, 2.5, 5, 10, 30]),
+            Gauge("ray_tpu_llm_queue_depth",
+                  "sequences waiting in the LLM admission queue"),
+            Gauge("ray_tpu_llm_tokens_per_step",
+                  "tokens processed by the last LLM engine step"),
+        )
+    return _llm_metrics
 
 
 async def start_metrics_http_server(registry: MetricsRegistry,

@@ -504,6 +504,21 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
             out["object_store_bytes"] = float(u.get("allocated", 0))
         except Exception:
             pass
+        # LLM serving pressure: replica engines on this node push these
+        # gauges with their worker metric snapshots; summing them here
+        # puts queue depth / tokens-per-step into the head time-series
+        # ring so `rtpu status --watch` shows serving load per node
+        from ray_tpu._private.metrics import default_registry
+
+        for key, family in (("llm_queue_depth", "ray_tpu_llm_queue_depth"),
+                            ("llm_tokens_per_step",
+                             "ray_tpu_llm_tokens_per_step")):
+            try:
+                v = default_registry.foreign_sample_sum(family)
+            except Exception:
+                v = None
+            if v is not None:
+                out[key] = float(v)
         return out
 
     def _pending_for_heartbeat(self) -> List[Dict[str, float]]:

@@ -223,6 +223,19 @@ class ObjectRefGenerator:
         s = self._worker._streams.get(self._task_id)
         return s is None or s.total is not None or s.error is not None
 
+    def cancel(self) -> None:
+        """Fire-and-forget cancellation of the producing task: the
+        worker raises TaskCancelledError in the replica-side generator,
+        whose finally releases whatever it holds (an LLM decode's KV
+        pages, file handles, ...).  Non-blocking — posted to the owner's
+        IO loop so an event-loop caller (the Serve proxy tearing down an
+        abandoned SSE stream) is never parked behind the cancel RPC."""
+        w = self._worker
+        try:
+            w._spawn(w._cancel_async(self._task_id, False))
+        except Exception:
+            pass  # runtime shutting down: the stream dies with it
+
     def __reduce__(self):
         raise TypeError(
             "ObjectRefGenerator cannot be pickled or passed to tasks; "

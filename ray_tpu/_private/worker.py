@@ -74,6 +74,9 @@ MODE_WORKER = "worker"
 # never shadowed by ActorHandle attribute lookup)
 PIPELINE_EXEC_METHOD = "__rt_dag_pipeline_loop__"
 PIPELINE_CTL_METHOD = "__rt_dag_pipeline_ctl__"
+# LLM serving decode loop (serve/llm.py): same pinned-loop contract —
+# the serve controller installs one per llm_deployment replica
+LLM_EXEC_METHOD = "__rt_dag_llm_loop__"
 
 _TASK_PUSH_TIMEOUT = 7 * 86400.0  # tasks may legitimately run for days
 _WARM_LEASE_TTL_S = 0.2  # idle leases stay pooled this long before return
@@ -490,6 +493,14 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
         # streaming generator tasks we own: task_id -> StreamState
         # (reference: _raylet.pyx ObjectRefGenerator machinery)
         self._streams: Dict[str, StreamState] = {}
+        # executor-side per-connection stream-item coalescing: many
+        # concurrent generator tasks (the LLM serving tier runs 64+
+        # token streams per replica) push items over ONE owner
+        # connection — batching them into one "stream_items" frame per
+        # flush tick replaces an RPC frame per token item (PR-8's
+        # frame-batching philosophy applied to the streaming path)
+        self._stream_out_lock = threading.Lock()
+        self._stream_out_bufs: Dict[int, Tuple[Any, List[Dict]]] = {}
         # in-flight batched pushes awaiting per-task "batch_result"
         # pushes: task_id -> completion context (loop-confined; popped
         # synchronously in the push handler so the batch's failure path
@@ -763,12 +774,28 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             if work:
                 asyncio.ensure_future(self._finish_batch_items(work))
             return
+        if method == "stream_items":
+            # coalesced frame: many items, possibly for many streams;
+            # apply all, then wake each touched stream once
+            touched = set()
+            for one in payload.get("items") or []:
+                s = self._apply_stream_item(one)
+                if s is not None:
+                    touched.add(s)
+            for s in touched:
+                s.wake()
+            return
         if method != "stream_item":
             return
+        s = self._apply_stream_item(payload)
+        if s is not None:
+            s.wake()
+
+    def _apply_stream_item(self, payload) -> Optional[StreamState]:
         tid = payload["task_id"]
         s = self._streams.get(tid)
         if s is None:
-            return  # generator abandoned; drop late items
+            return None  # generator abandoned; drop late items
         idx = payload["index"]
         oid = ObjectID.from_index(TaskID.from_hex(tid), idx + 1).hex()
         item = payload["item"]
@@ -781,9 +808,9 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                 self._obj_sizes[oid] = item["stored"]["size"]
             self.memory.set_in_plasma(oid, node)
         else:
-            return  # malformed item
+            return None  # malformed item
         s.arrived = max(s.arrived, idx + 1)
-        s.wake()
+        return s
 
     async def _aclient_agent(self, addr: Tuple[str, int]) -> RpcClient:
         addr = (addr[0], addr[1])
@@ -3385,6 +3412,14 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                         else:
                             value = _pipe.run_stage_ctl(
                                 self, self._actor_instance, *args)
+                    elif spec.method_name == LLM_EXEC_METHOD:
+                        # LLM serving decode loop (serve/llm.py): pins
+                        # this exec thread to the replica engine's
+                        # continuous-batching step loop
+                        from ray_tpu.serve import llm as _serve_llm
+
+                        value = _serve_llm.run_llm_loop(
+                            self, self._actor_instance, *args)
                     else:
                         raise AttributeError(
                             f"unknown compiled-DAG system method "
@@ -3582,24 +3617,61 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                                        "node": list(self.agent_addr),
                                        "size": size}}
                 if conn is not None:
-                    # ordered: item posts and the final reply post (see
-                    # _post_exec_reply) ride the SAME coalesced FIFO
-                    # buffer, and each push writes its frame in the
-                    # coroutine's first step — so items and the reply
-                    # arrive in order (a mixed direct/coalesced scheme
-                    # could let an already-queued drain resolve the
-                    # reply ahead of a still-queued item callback)
-                    self._post_to_loop(
-                        _aio.ensure_future,
-                        conn.push("stream_item", {
-                            "task_id": spec.task_id, "index": n,
-                            "item": wire}))
+                    # per-connection coalescing: items buffer locally
+                    # and ride ONE "stream_items" frame per flush tick
+                    # shared by every stream on this owner connection.
+                    # Ordering vs the final reply is preserved by the
+                    # flush-now below: the drain lands on the IO loop's
+                    # FIFO ahead of the reply post (_post_exec_reply)
+                    self._queue_stream_item(conn, {
+                        "task_id": spec.task_id, "index": n,
+                        "item": wire})
                 n += 1
         except BaseException as e:
+            if conn is not None:
+                self._flush_stream_items_now(conn)
             reply = self._error_reply(spec, e, traceback.format_exc())
             reply["stream_len"] = n  # items before the break stay valid
             return reply
+        if conn is not None:
+            self._flush_stream_items_now(conn)
         return {"results": [], "stream_len": n}
+
+    _STREAM_FLUSH_S = 0.002  # stream-item coalescing window
+
+    def _queue_stream_item(self, conn, payload: Dict[str, Any]) -> None:
+        """Buffer one stream item for its owner connection; the first
+        item of a batch schedules the flush tick."""
+        key = id(conn)
+        with self._stream_out_lock:
+            ent = self._stream_out_bufs.get(key)
+            if ent is None:
+                ent = self._stream_out_bufs[key] = (conn, [])
+            ent[1].append(payload)
+            first = len(ent[1]) == 1
+        if first:
+            self._post_to_loop(self._schedule_stream_flush, key)
+
+    def _schedule_stream_flush(self, key: int) -> None:
+        # IO loop: delay one tick so concurrent streams' items coalesce
+        self._loop().call_later(self._STREAM_FLUSH_S,
+                                self._flush_stream_items, key)
+
+    def _flush_stream_items(self, key: int) -> None:
+        # IO loop: one frame carries everything buffered for this conn
+        import asyncio as _aio
+
+        with self._stream_out_lock:
+            ent = self._stream_out_bufs.pop(key, None)
+        if ent is None:
+            return  # a flush-now already drained it
+        conn, items = ent
+        _aio.ensure_future(conn.push("stream_items", {"items": items}))
+
+    def _flush_stream_items_now(self, conn) -> None:
+        """Drain pending items ahead of this stream's final reply (the
+        reply post queues behind this on the same IO-loop FIFO)."""
+        self._post_to_loop(self._flush_stream_items, id(conn))
 
     _async_exec_loop = None
     _async_exec_lock = threading.Lock()

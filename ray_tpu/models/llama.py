@@ -14,6 +14,17 @@ is green-field, designed for the MXU and GSPMD from the start:
     one jit trace covers the whole step
   - `llama_param_rules` gives PartitionSpecs for tp (heads / mlp hidden)
     and fsdp (everything else) so the same module runs 1-chip or pod
+
+Incremental decoding (the LLM serving tier, serve/llm.py): the same
+modules accept an optional paged KV-cache pytree (``make_kv_cache`` /
+``decode_cache_args``).  The cache is PAGING-AGNOSTIC here — the model
+sees flat per-layer slot pools plus precomputed write-slot and
+context-gather index arrays; the serving engine owns the block tables
+that map sequence positions to physical page slots.  New keys/values
+are written post-rope at their absolute positions, context is gathered
+dense per sequence, and causality is enforced with a position mask
+(``ctx_pos <= q_pos``), so chunked prefill and single-token decode ride
+one code path with static shapes.
 """
 
 from __future__ import annotations
@@ -113,6 +124,33 @@ def default_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.reshape(b, s, h, d)
 
 
+def cached_attention(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
+                     ctx: jax.Array, ctx_pos: jax.Array,
+                     ctx_mask: jax.Array, q_pos: jax.Array) -> jax.Array:
+    """Attention over a slot-pool KV cache.
+
+    q: [B,S,H,D] (post-rope); pool_k/pool_v: [T,Hkv,D] flat slot pools
+    (already containing this call's keys/values); ctx: [B,L] physical
+    slot index of each context entry (garbage entries point at slot 0);
+    ctx_pos: [B,L] the token position each entry holds; ctx_mask: [B,L]
+    validity; q_pos: [B,S] query positions.  Causality = position mask,
+    so one kernel serves chunked prefill (S>1) and decode (S=1)."""
+    b, s, h, d = q.shape
+    hkv = pool_k.shape[1]
+    group = h // hkv
+    ck = pool_k[ctx.reshape(-1)].reshape(b, ctx.shape[1], hkv, d)
+    cv = pool_v[ctx.reshape(-1)].reshape(b, ctx.shape[1], hkv, d)
+    q5 = q.reshape(b, s, hkv, group, d)
+    logits = jnp.einsum("bshgd,blhd->bhgsl", q5, ck).astype(jnp.float32)
+    logits = logits / jnp.sqrt(d).astype(jnp.float32)
+    mask = (ctx_pos[:, None, :] <= q_pos[:, :, None]) \
+        & ctx_mask[:, None, :]                      # [B,S,L]
+    logits = jnp.where(mask[:, None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(cv.dtype)
+    out = jnp.einsum("bhgsl,blhd->bshgd", probs, cv)
+    return out.reshape(b, s, h, d)
+
+
 class RMSNorm(nn.Module):
     eps: float = 1e-5
 
@@ -129,7 +167,7 @@ class Attention(nn.Module):
     kernel: Optional[Callable] = None  # pluggable (flash/ring) attention
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, cache=None):
         cfg = self.cfg
         dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
                         param_dtype=jnp.float32)
@@ -138,11 +176,26 @@ class Attention(nn.Module):
         v = dense(features=(cfg.n_kv_heads, cfg.head_dim), name="wv")(x)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
+        wo = nn.DenseGeneral(features=cfg.dim, axis=(-2, -1), use_bias=False,
+                             dtype=cfg.dtype, param_dtype=jnp.float32,
+                             name="wo")
+        if cache is not None:
+            # incremental path: write post-rope k/v into this layer's
+            # flat slot pools, attend over the gathered context.  Slot 0
+            # is the engine's designated garbage slot — inactive batch
+            # lanes write there and mask it out of their context.
+            b, s = k.shape[0], k.shape[1]
+            flat = cache["slots"].reshape(-1)
+            pool_k = cache["k"].at[flat].set(
+                k.reshape(b * s, *k.shape[2:]))
+            pool_v = cache["v"].at[flat].set(
+                v.reshape(b * s, *v.shape[2:]))
+            out = cached_attention(q, pool_k, pool_v, cache["ctx"],
+                                   cache["ctx_pos"], cache["ctx_mask"],
+                                   positions)
+            return wo(out), pool_k, pool_v
         attend = self.kernel or default_attention
-        out = attend(q, k, v)
-        return nn.DenseGeneral(features=cfg.dim, axis=(-2, -1), use_bias=False,
-                               dtype=cfg.dtype, param_dtype=jnp.float32,
-                               name="wo")(out)
+        return wo(attend(q, k, v))
 
 
 class Mlp(nn.Module):
@@ -163,9 +216,16 @@ class Block(nn.Module):
     kernel: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x, positions):
-        x = x + Attention(self.cfg, self.kernel, name="attn")(
-            RMSNorm(self.cfg.norm_eps, name="attn_norm")(x), positions)
+    def __call__(self, x, positions, cache=None):
+        attn_in = RMSNorm(self.cfg.norm_eps, name="attn_norm")(x)
+        attn = Attention(self.cfg, self.kernel, name="attn")
+        if cache is not None:
+            a, pool_k, pool_v = attn(attn_in, positions, cache)
+            x = x + a
+            x = x + Mlp(self.cfg, name="mlp")(
+                RMSNorm(self.cfg.norm_eps, name="mlp_norm")(x))
+            return x, pool_k, pool_v
+        x = x + attn(attn_in, positions)
         x = x + Mlp(self.cfg, name="mlp")(
             RMSNorm(self.cfg.norm_eps, name="mlp_norm")(x))
         return x
@@ -176,10 +236,30 @@ class LlamaModel(nn.Module):
     kernel: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, cache=None):
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
                      param_dtype=jnp.float32, name="embed")(tokens)
+        if cache is not None:
+            # incremental decode/prefill over the paged KV cache: query
+            # positions come from the engine, per-layer pools are
+            # threaded through and returned updated
+            positions = cache["q_pos"]
+            new_k, new_v = [], []
+            for i in range(cfg.n_layers):
+                layer_cache = {"k": cache["k"][i], "v": cache["v"][i],
+                               "slots": cache["slots"], "ctx": cache["ctx"],
+                               "ctx_pos": cache["ctx_pos"],
+                               "ctx_mask": cache["ctx_mask"]}
+                x, pk, pv = Block(cfg, self.kernel, name=f"layer_{i}")(
+                    x, positions, layer_cache)
+                new_k.append(pk)
+                new_v.append(pv)
+            x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+            logits = nn.Dense(cfg.vocab_size, use_bias=False,
+                              dtype=cfg.dtype, param_dtype=jnp.float32,
+                              name="lm_head")(x)
+            return logits, {"k": new_k, "v": new_v}
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[1]), tokens.shape)
         block_cls = Block
@@ -239,6 +319,27 @@ class LlamaStage(nn.Module):
             x = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                          param_dtype=jnp.float32, name="lm_head")(x)
         return x
+
+
+def make_kv_pools(cfg: LlamaConfig, num_slots: int,
+                  dtype: Any = None) -> Dict[str, Any]:
+    """Allocate flat per-layer KV slot pools for incremental decoding.
+
+    ``num_slots`` = pages x page_size; slot 0 is reserved as the
+    garbage slot for inactive batch lanes (serve/llm.py never hands it
+    to a sequence).  Sized from ``n_kv_heads``/``head_dim`` — the GQA
+    shrink is exactly what makes a resident cache affordable."""
+    dtype = dtype or cfg.dtype
+    shape = (num_slots, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": [jnp.zeros(shape, dtype) for _ in range(cfg.n_layers)],
+            "v": [jnp.zeros(shape, dtype) for _ in range(cfg.n_layers)]}
+
+
+def kv_pool_bytes(cfg: LlamaConfig, num_slots: int) -> int:
+    """Resident bytes of one replica's KV pools (both k and v)."""
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    return (2 * cfg.n_layers * num_slots * cfg.n_kv_heads
+            * cfg.head_dim * itemsize)
 
 
 def llama_param_rules() -> Dict[str, Any]:

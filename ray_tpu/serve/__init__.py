@@ -13,6 +13,8 @@ from ray_tpu.serve.api import (Application, Deployment, DeploymentHandle,
                                shutdown)
 from ray_tpu.serve.http import (proxy_addresses, shutdown_http,
                                 start_http, start_per_node_http)
+from ray_tpu.serve.llm import (LLMEngine, LLMOverloadedError,
+                               llm_deployment)
 from ray_tpu.serve.rpc_ingress import (RpcIngressClient, start_rpc_ingress,
                                        stop_rpc_ingress)
 
@@ -20,4 +22,5 @@ __all__ = ["deployment", "run", "get_handle", "delete", "shutdown",
            "batch", "Deployment", "DeploymentHandle", "Application",
            "start_http", "start_per_node_http", "proxy_addresses",
            "shutdown_http", "start_rpc_ingress", "stop_rpc_ingress",
-           "RpcIngressClient", "DeploymentFailedError"]
+           "RpcIngressClient", "DeploymentFailedError",
+           "llm_deployment", "LLMEngine", "LLMOverloadedError"]

@@ -90,11 +90,18 @@ def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 8,
 
 
 class _BatchState:
-    def __init__(self, max_batch_size: int, wait_s: float):
+    def __init__(self, max_batch_size: int, wait_s: float,
+                 clock: Callable[[], float] = time.monotonic):
         self.max = max_batch_size
         self.wait = wait_s
+        self.clock = clock  # injectable for deterministic timer tests
         self.owner_pin = None  # set for non-weakref-able owners
         self.lock = threading.Lock()
+        # submitters notify the parked flusher the moment the batch
+        # fills — a full batch flushes immediately instead of being
+        # rediscovered by a poll tick (was a 1ms sleep-poll loop: up to
+        # 1ms added latency per batch and a busy core at high rates)
+        self.full = threading.Condition(self.lock)
         self.items: List[Any] = []
         self.futures: List[Any] = []
         self.flusher_here = False
@@ -109,18 +116,20 @@ class _BatchState:
             i_flush = not self.flusher_here
             if i_flush:
                 self.flusher_here = True
+            elif len(self.items) >= self.max:
+                self.full.notify()  # wake the flusher: batch is full
         if not i_flush:
             return fut.result(timeout=120)
         # this caller is the flusher: drain every batch, then hand back
         try:
             while True:
-                deadline = time.monotonic() + self.wait
-                while time.monotonic() < deadline:
-                    with self.lock:
-                        if len(self.items) >= self.max:
-                            break
-                    time.sleep(min(0.001, self.wait / 4 or 0.001))
+                deadline = self.clock() + self.wait
                 with self.lock:
+                    while len(self.items) < self.max:
+                        remaining = deadline - self.clock()
+                        if remaining <= 0:
+                            break
+                        self.full.wait(remaining)
                     items = self.items[:self.max]
                     futures = self.futures[:self.max]
                     del self.items[:self.max]
@@ -168,6 +177,10 @@ class Deployment:
     # reported ongoing requests: {"min_replicas", "max_replicas",
     # "target_ongoing_requests"}
     autoscaling_config: Optional[Dict[str, Any]] = None
+    # LLM serving tier (serve/llm.py llm_deployment): replicas host a
+    # continuous-batching engine and the controller installs a pinned
+    # decode loop on each one
+    llm: bool = False
 
     def options(self, **opts) -> "Deployment":
         d = Deployment(self.func_or_class, self.name, self.num_replicas,
@@ -175,7 +188,8 @@ class Deployment:
                        dict(self.ray_actor_options),
                        self.init_args, dict(self.init_kwargs),
                        dict(self.autoscaling_config)
-                       if self.autoscaling_config else None)
+                       if self.autoscaling_config else None,
+                       self.llm)
         for k, v in opts.items():
             setattr(d, k, v)
         return d
@@ -242,6 +256,16 @@ class _Replica:
     def health(self):
         return True
 
+    def __getattr__(self, name):
+        # stateful-restart hooks (worker.py __rt_save__/__rt_restore__)
+        # delegate to the wrapped callable WHEN IT DEFINES THEM — via
+        # __getattr__ so plain replicas still fail hasattr() and skip
+        # the autosave machinery entirely
+        if name in ("__rt_save__", "__rt_restore__") \
+                and "_callable" in self.__dict__:
+            return getattr(self.__dict__["_callable"], name)
+        raise AttributeError(name)
+
 
 class ServeController:
     """Named actor owning deployment state, with a background
@@ -304,6 +328,7 @@ class ServeController:
                         "actor_options": app["actor_options"],
                         "max_ongoing": app["max_ongoing"],
                         "autoscaling": app["autoscaling"],
+                        "llm": app.get("llm", False),
                         "desired": app["desired"],
                         "version": app["version"],
                         "replica_names": list(
@@ -351,6 +376,7 @@ class ServeController:
                 "actor_options": spec["actor_options"],
                 "max_ongoing": spec["max_ongoing"],
                 "autoscaling": spec["autoscaling"],
+                "llm": spec.get("llm", False),
                 "desired": spec["desired"],
                 "replicas": replicas,
                 "replica_names": replica_names,
@@ -391,7 +417,8 @@ class ServeController:
                max_ongoing: int, init_args, init_kwargs,
                actor_options: Dict[str, Any],
                autoscaling: Optional[Dict[str, Any]] = None,
-               health_timeout: Optional[float] = None):
+               health_timeout: Optional[float] = None,
+               llm: bool = False):
         import ray_tpu
 
         if autoscaling:
@@ -404,6 +431,7 @@ class ServeController:
             "actor_options": actor_options,
             "max_ongoing": max_ongoing,
             "autoscaling": autoscaling,
+            "llm": llm,
             "desired": num_replicas,
             "replicas": [],
             "replica_names": {},  # actor_id -> detached actor name
@@ -461,17 +489,44 @@ class ServeController:
         import ray_tpu
 
         # detached + named: replicas survive a controller crash and are
-        # reattached from the checkpoint by name
+        # reattached from the checkpoint by name.  LLM replicas get two
+        # extra exec threads: one is permanently pinned by the decode
+        # loop, one keeps control methods (stats/health) responsive
+        # when every other thread sits in a streaming request
         rname = f"serve:{dep_name}:{uuid.uuid4().hex[:8]}"
         cls = ray_tpu.remote(_Replica).options(
-            max_concurrency=max(2, app["max_ongoing"]),
+            max_concurrency=max(2, app["max_ongoing"])
+            + (2 if app.get("llm") else 0),
             name=rname, lifetime="detached",
             **app["actor_options"])
         h = cls.remote(app["target_blob"], app["init_args"],
                        app["init_kwargs"])
         with self._lock:  # _save_checkpoint iterates this under the lock
             app["replica_names"][h._actor_id] = rname
+        self._ensure_llm_loop(app, h)
         return h
+
+    def _ensure_llm_loop(self, app, replica) -> None:
+        """Install the pinned continuous-batching decode loop on an LLM
+        replica (worker-side dispatch: __rt_dag_llm_loop__, serve/llm.py
+        run_llm_loop).  Idempotent — the engine's run_loop is
+        single-flight, so re-ensuring after a controller restart (which
+        loses the in-memory loop_refs) is safe."""
+        if not app.get("llm"):
+            return
+        try:
+            from ray_tpu import api as _rapi
+            from ray_tpu._private.worker import LLM_EXEC_METHOD
+
+            w = _rapi._worker()
+            ref = w.submit_actor_task(
+                replica._actor_id, LLM_EXEC_METHOD, (), {})[0]
+            with self._lock:
+                # the ref pins the loop task owner-side; reconcile uses
+                # the key set to avoid re-submitting every round
+                app.setdefault("loop_refs", {})[replica._actor_id] = ref
+        except Exception:
+            pass  # replica mid-create or unreachable: reconcile retries
 
     # ---- reconciliation ----------------------------------------------------
 
@@ -497,20 +552,69 @@ class ServeController:
                     pass
 
     def _reconcile_one(self, ray_tpu, name: str, app: Dict[str, Any]):
-        # 1. health: drop replicas that fail a health probe
+        # 0. llm decode loops: replicas recovered from a checkpoint (the
+        # in-memory loop_refs died with the old controller) get their
+        # loop re-ensured once — harmless on running loops.  Every few
+        # seconds ALSO ask each replica whether its loop is still
+        # running: a loop task that died (engine error, install push
+        # cancelled by a controller-connection drop) would otherwise
+        # leave a black-hole replica that admits sequences nothing
+        # steps — re-ensuring is idempotent (engine-side single-flight)
+        if app.get("llm"):
+            with self._lock:
+                missing = [r for r in app["replicas"]
+                           if r._actor_id not in app.get("loop_refs", {})]
+            for r in missing:
+                self._ensure_llm_loop(app, r)
+            now0 = time.monotonic()
+            if now0 >= app.get("next_loop_check", 0.0):
+                app["next_loop_check"] = now0 + 3.0
+                # submit all probes first so the 5s timeouts overlap —
+                # one wedged replica must not stall the round 5s per
+                # replica (same pattern as the health pass below)
+                checks = [(r, r.handle_request.remote("stats", (), {}))
+                          for r in app["replicas"]]
+                for r, ref in checks:
+                    try:
+                        st = ray_tpu.get(ref, timeout=5)
+                        if not st.get("loop_running"):
+                            with self._lock:
+                                app.get("loop_refs", {}).pop(
+                                    r._actor_id, None)
+                            self._ensure_llm_loop(app, r)
+                    except ray_tpu.RayError:
+                        pass  # health pass below handles dead replicas
+        # 1. health: drop replicas that fail a health probe.  Definitive
+        # death (ActorDied/worker gone) drops immediately; a TIMEOUT
+        # alone needs consecutive misses — a replica paying a long jit
+        # compile or a GIL-heavy stretch (an LLM replica's first
+        # forward) must not be executed for being slow once, which
+        # previously aborted it MID-COMPILE and churned replacements
+        from ray_tpu._private.errors import GetTimeoutError
+
         alive = []
         changed = False
+        misses = app.setdefault("health_misses", {})
         probes = [(r, r.health.remote()) for r in app["replicas"]]
         for r, probe in probes:
             try:
                 ray_tpu.get(probe, timeout=5)
                 alive.append(r)
+                misses.pop(r._actor_id, None)
+                continue
+            except GetTimeoutError:
+                misses[r._actor_id] = misses.get(r._actor_id, 0) + 1
+                if misses[r._actor_id] < 3:
+                    alive.append(r)  # grace: still routed, watched
+                    continue
             except ray_tpu.RayError:
-                changed = True
-                try:
-                    ray_tpu.kill(r)
-                except Exception:
-                    pass
+                pass  # dead for real: replace now
+            changed = True
+            misses.pop(r._actor_id, None)
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
         # 2. autoscaling: follow reported ongoing requests
         desired = app["desired"]
         auto = app.get("autoscaling")
@@ -572,6 +676,13 @@ class ServeController:
                         v._actor_id for v, _ in app.get("draining", [])}
                     app["replica_names"] = {
                         aid: rn for aid, rn in app["replica_names"].items()
+                        if aid in live_ids}
+                    app["loop_refs"] = {
+                        aid: ref for aid, ref in
+                        app.get("loop_refs", {}).items() if aid in live_ids}
+                    app["health_misses"] = {
+                        aid: n for aid, n in
+                        app.get("health_misses", {}).items()
                         if aid in live_ids}
                     self._version_counter += 1
                     app["version"] = self._version_counter
@@ -760,6 +871,19 @@ class _SharedWaiter:
 _shared_waiter = _SharedWaiter()
 
 
+def _abandon_stream(gen) -> None:
+    """A stream consumer stopped before exhaustion (client disconnect,
+    early break, GC of the wrapper): cancel the replica-side generator
+    so it stops producing — an abandoned LLM decode must free its KV
+    pages, not generate to max_seq_len for nobody.  No-op for streams
+    whose producer already finished."""
+    try:
+        if not gen.completed():
+            gen.cancel()
+    except Exception:
+        pass
+
+
 def _watch_ref_done(ref, cb) -> None:
     """Fire ``cb`` once `ref` resolves (value OR error), releasing a
     handle's inflight charge.
@@ -902,8 +1026,12 @@ class DeploymentHandle:
         self._last_refresh = now
         try:
             ctrl = _controller()
+            # an empty local roster (every cached replica was dropped as
+            # dead) asks for the FULL roster: sending our version would
+            # get "unchanged" back and leave the handle empty forever
+            known = self._version if self._replicas else -1
             info = ray_tpu.get(
-                ctrl.get_replicas.remote(self._name, self._version),
+                ctrl.get_replicas.remote(self._name, known),
                 timeout=30)
         except Exception:
             # refresh is best-effort: during a controller restart the
@@ -925,8 +1053,9 @@ class DeploymentHandle:
         self._last_refresh = now
         try:
             ctrl = _controller()
+            known = self._version if self._replicas else -1
             info = await ray_tpu.get_async(
-                ctrl.get_replicas.remote(self._name, self._version),
+                ctrl.get_replicas.remote(self._name, known),
                 timeout=30)
         except Exception:
             return  # best-effort, same as the sync path
@@ -935,7 +1064,11 @@ class DeploymentHandle:
     def _apply_refresh(self, info) -> None:
         if info is None or info.get("unchanged"):
             return
-        if info["version"] != self._version:
+        # same-version rosters still apply when the local list is empty:
+        # a handle that _drop_replica'd its way to zero (every cached
+        # replica looked dead) must be able to re-learn the roster even
+        # though the controller's version never moved
+        if info["version"] != self._version or not self._replicas:
             with self._lock:
                 self._version = info["version"]
                 self._max_ongoing = info.get("max_ongoing",
@@ -1128,21 +1261,33 @@ class DeploymentHandle:
             try:
                 yield from gen
             finally:
+                _abandon_stream(gen)
                 _release()
 
         return _wrapped()
 
-    async def stream_async(self, *args, _method: str = "__call__", **kwargs):
+    async def stream_async(self, *args, _method: str = "__call__",
+                           _exclude=None, _info=None, **kwargs):
         """Async stream(): returns an async iterator of per-item
         ObjectRefs, item arrival awaited on the calling loop (no thread
         parked per stream).  The replica call is submitted EAGERLY in
         the caller's context — an active ingress span parents the
         serve.stream span, and an abandoned (never-iterated) stream
-        still releases its inflight charge via the shared waiter."""
+        still releases its inflight charge via the shared waiter.
+
+        ``_exclude``/``_info`` serve the proxy's mid-stream resume
+        retry: a retrying caller learns which replica served it (rid
+        recorded into ``_info``) and skips replicas it already saw die
+        — a freshly-refreshed roster may briefly still list them, and
+        a dead replica's zero inflight makes least-outstanding choice
+        otherwise gravitate right back to it."""
         await self._refresh_async()
         if not self._replicas:
             await self._refresh_async(force=True)
-        replica, rid = self._pick_replica(local_pref=False)
+        replica, rid = self._pick_replica(local_pref=False,
+                                          exclude=_exclude)
+        if _info is not None:
+            _info["rid"] = rid
         gen, _release = self._submit_stream(replica, rid, _method, args,
                                             kwargs)
 
@@ -1151,6 +1296,7 @@ class DeploymentHandle:
                 async for ref in gen:
                     yield ref
             finally:
+                _abandon_stream(gen)
                 _release()
 
         return _aiter()
@@ -1207,7 +1353,7 @@ def run(app: Application, name: Optional[str] = None) -> DeploymentHandle:
             dep_name, cloudpickle.dumps(d.func_or_class), d.num_replicas,
             d.max_ongoing_requests, d.init_args, d.init_kwargs,
             d.ray_actor_options, d.autoscaling_config,
-            float(config.serve_replica_health_timeout_s)),
+            float(config.serve_replica_health_timeout_s), d.llm),
             timeout=float(config.serve_replica_health_timeout_s) + 120.0)
     except ray_tpu.RayTaskError as e:
         if isinstance(e.cause, DeploymentFailedError):

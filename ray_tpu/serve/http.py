@@ -41,6 +41,24 @@ PROXY_NAME = "_serve_http_proxy"
 # sentinel first element of a _read_request error result
 _PARSE_ERR = "_err"
 
+# sentinel for "stream produced no first item" in the prefetch path
+_NO_ITEM = object()
+
+
+def _is_overload_error(e) -> bool:
+    """Replica-side admission shed (serve/llm.py LLMOverloadedError)
+    riding inside a RayTaskError chain — matched structurally so the
+    proxy can answer 503 without importing the llm module on the hot
+    path."""
+    seen = set()
+    while e is not None and id(e) not in seen:
+        seen.add(id(e))
+        if type(e).__name__ == "LLMOverloadedError" \
+                or "LLMOverloadedError" in str(e):
+            return True
+        e = getattr(e, "cause", None) or e.__cause__
+    return False
+
 
 class _GateCharge:
     """Once-only holder of one admission-gate slot.  Released by the
@@ -443,6 +461,12 @@ class _HttpProxy:
                     yield item
             finally:
                 charge.release()
+                # close the chain explicitly: GC finalization of the
+                # inner generators is too late for disconnect-cancel
+                try:
+                    await agen.aclose()
+                except Exception:
+                    pass
 
         return _gen()
 
@@ -474,7 +498,28 @@ class _HttpProxy:
         try:
             if want_stream:
                 gen = await self._stream_async_values(path, arg)
-                return "200 OK", b"", gen
+                # prefetch the FIRST item before committing a status
+                # line: replica-side admission errors (the LLM tier's
+                # 503 shed, bad requests) become real status codes
+                # instead of an error chunk behind a 200 — and TTFT for
+                # token streams was always going to wait for this item
+                try:
+                    first = await gen.__anext__()
+                except StopAsyncIteration:
+                    first = _NO_ITEM
+                except Exception as e:
+                    with_suppress = getattr(gen, "aclose", None)
+                    if with_suppress is not None:
+                        try:
+                            await with_suppress()
+                        except Exception:
+                            pass
+                    if _is_overload_error(e):
+                        return ("503 Service Unavailable", json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}).encode(),
+                            None)
+                    raise
+                return "200 OK", b"", self._chain_first(first, gen)
             result = await self._call_async(path, arg)
         except KeyError:
             return "404 Not Found", json.dumps(
@@ -487,6 +532,22 @@ class _HttpProxy:
         except TypeError:
             payload = json.dumps(str(result)).encode()
         return "200 OK", payload, None
+
+    @staticmethod
+    def _chain_first(first, agen):
+        """Re-attach a prefetched first item in front of the remaining
+        stream; closing the chain closes the underlying stream (the
+        disconnect-cancel path rides these aclose hops)."""
+        async def _gen():
+            try:
+                if first is not _NO_ITEM:
+                    yield first
+                async for item in agen:
+                    yield item
+            finally:
+                await agen.aclose()
+
+        return _gen()
 
     async def _call_async(self, name: str, arg: Any):
         """The hot path: submit + await through the handle's
@@ -513,40 +574,115 @@ class _HttpProxy:
         — the ingress span is still active, so the serve.stream span
         parents correctly (the returned generator first runs later, in
         the writer task's context).  A stale cached handle refreshes
-        once — safe to restart the stream only before any item was
-        consumed."""
+        once — safe to restart the stream unconditionally only before
+        any item was consumed.
+
+        Mid-stream replica death is additionally survivable for
+        RESUMABLE streams — ones whose every item is a dict carrying an
+        integer generation index "i" (the LLM serving contract): the
+        request is re-sent once with ``emit_from`` = last delivered
+        index + 1 (and the original dict arg, so a ``request_id``
+        re-attaches to live sequence state on a surviving replica).
+        The client sees at most one duplicated token boundary; greedy
+        decode is deterministic, so a re-prefill on a survivor yields
+        identical tokens."""
         import ray_tpu
 
+        info: Dict[str, Any] = {}
         handle = await self._resolve_handle_async(name)
-        agen = await handle.stream_async(arg)
+        agen = await handle.stream_async(arg, _info=info)
 
         async def _values():
+            import asyncio
+
+            from ray_tpu._private.config import config
+            from ray_tpu._private.errors import (ActorDiedError,
+                                                 ActorUnavailableError,
+                                                 RayWorkerError)
+
+            dead_errors = (ActorDiedError, ActorUnavailableError,
+                           RayWorkerError)
             nonlocal handle, agen
-            yielded = retried = False
-            while True:
-                try:
+            yielded = False
+            resumable = isinstance(arg, dict)
+            last_i = None
+            # pre-first-item restarts keep the old once-only budget;
+            # mid-stream RESUMES get the dead-replica retry budget,
+            # excluding replicas this stream already saw die (a fresh
+            # roster may briefly still list them, and their zero
+            # inflight would draw the least-outstanding pick back)
+            attempts = 1 + max(0, int(config.serve_dead_replica_retries))
+            retries = 0
+            dead: set = set()
+            try:
+                while True:
                     try:
-                        ref = await agen.__anext__()
-                    except StopAsyncIteration:
-                        return
-                    value = await ray_tpu.get_async(ref, timeout=120)
-                except ray_tpu.RayError:
-                    if yielded or retried:
-                        raise  # mid-stream death: cannot restart
-                    retried = True
-                    handle = await self._resolve_handle_async(name,
-                                                              fresh=True)
-                    agen = await handle.stream_async(arg)
-                    continue
-                yielded = True
-                yield value
+                        try:
+                            ref = await agen.__anext__()
+                        except StopAsyncIteration:
+                            return
+                        value = await ray_tpu.get_async(ref, timeout=120)
+                    except ray_tpu.RayTaskError:
+                        raise  # user/application error: never retried
+                    except ray_tpu.RayError as e:
+                        retries += 1
+                        if isinstance(e, dead_errors) and info.get("rid"):
+                            # only replica DEATH blacklists the replica;
+                            # transient runtime errors must not strip a
+                            # healthy roster
+                            dead.add(info["rid"])
+                            handle._drop_replica(info["rid"])
+                        if not yielded:
+                            if retries > 1:
+                                raise
+                            handle = await self._resolve_handle_async(
+                                name, fresh=True)
+                            agen = await handle.stream_async(
+                                arg, _exclude=dead, _info=info)
+                            continue
+                        if resumable and last_i is not None \
+                                and retries <= attempts:
+                            await asyncio.sleep(0.25 * retries)
+                            handle = await self._resolve_handle_async(
+                                name, fresh=True)
+                            agen = await handle.stream_async(
+                                {**arg, "emit_from": last_i + 1},
+                                _exclude=dead, _info=info)
+                            continue
+                        raise  # mid-stream death, not resumable
+                    yielded = True
+                    if resumable and isinstance(value, dict) \
+                            and isinstance(value.get("i"), int):
+                        # coalesced items cover [i, i+len(tokens)-1]
+                        span = value.get("tokens")
+                        last_i = value["i"] + (
+                            len(span) - 1 if isinstance(span, list)
+                            and span else 0)
+                    else:
+                        resumable = False
+                    yield value
+            finally:
+                # closing the value stream closes the handle stream,
+                # whose finally cancels an unfinished replica-side
+                # generator — the disconnect -> free-KV-pages path
+                try:
+                    await agen.aclose()
+                except Exception:
+                    pass
 
         return _values()
 
     async def _write_chunked(self, writer, agen, keep: bool):
         """One HTTP/1.1 chunk per streamed item (JSON + newline), pulled
         off the async value iterator on this loop.  Chunked framing is
-        self-terminating, so the connection stays alive afterwards."""
+        self-terminating, so the connection stays alive afterwards.
+
+        Client disconnect (write/drain raising a connection error) stops
+        the pull loop IMMEDIATELY — no error chunk is owed to a dead
+        peer — and the finally's aclose cascades down the stream chain:
+        gate charge released, handle inflight released, replica-side
+        generator cancelled (an abandoned LLM decode frees its KV pages
+        instead of generating to max_seq_len)."""
         try:
             writer.write(b"HTTP/1.1 200 OK\r\n"
                          b"Content-Type: text/event-stream\r\n"
@@ -555,22 +691,33 @@ class _HttpProxy:
                          (b"keep-alive" if keep else b"close") +
                          b"\r\n\r\n")
             await writer.drain()
-            try:
-                async for item in agen:
-                    try:
-                        data = json.dumps(item).encode() + b"\n"
-                    except TypeError:
-                        data = json.dumps(str(item)).encode() + b"\n"
+            while True:
+                # the PRODUCER pull gets its own try: any replica-side
+                # failure (including timeouts, which share bases with
+                # connection errors) is reported to the still-live peer
+                # as an error chunk — only WRITER failures below mean
+                # the peer itself is gone
+                try:
+                    item = await agen.__anext__()
+                except StopAsyncIteration:
+                    break
+                except Exception as e:
+                    data = json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode()
                     writer.write(hex(len(data))[2:].encode() + b"\r\n"
                                  + data + b"\r\n")
-                    await writer.drain()
-            except Exception as e:
-                data = json.dumps(
-                    {"error": f"{type(e).__name__}: {e}"}).encode()
+                    break
+                try:
+                    data = json.dumps(item).encode() + b"\n"
+                except TypeError:
+                    data = json.dumps(str(item)).encode() + b"\n"
                 writer.write(hex(len(data))[2:].encode() + b"\r\n"
                              + data + b"\r\n")
+                await writer.drain()
             writer.write(b"0\r\n\r\n")
             await writer.drain()
+        except (ConnectionError, TimeoutError, OSError):
+            pass  # disconnect: the finally tears the producer down
         finally:
             # explicit close, not GC: a peer that vanished mid-stream
             # must release the admission-gate charge NOW (asyncgen

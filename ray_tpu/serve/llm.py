@@ -1,0 +1,812 @@
+"""LLM serving tier: continuous batching over a paged KV cache.
+
+The request/response Serve data plane re-dispatches one forward per
+``@serve.batch`` flush — decode-heavy LLM traffic pays a dispatch per
+token step and the accelerator idles between batches.  This module is
+the resident-program alternative (the Gemma-on-TPU serving shape): a
+replica hosts ONE :class:`LLMEngine` whose decode loop is pinned to an
+exec thread through the compiled-DAG dispatch branch
+(``__rt_dag_llm_loop__`` in worker.py) and never re-dispatches.  New
+sequences are admitted into the running batch at token boundaries
+(continuous batching), every sequence owns pages in a paged KV cache
+(block-table indexed, recycled on EOS/cancel/disconnect), long prompts
+prefill in chunks so they cannot stall in-flight decodes, and generated
+tokens stream out per sequence through the existing
+``stream_async`` -> SSE path.
+
+Request contract (token-level; tokenization is the client's concern):
+  {"tokens": [int, ...],        # prompt token ids
+   "max_new_tokens": int,       # decode budget (>= 1)
+   "eos": int | None,           # optional stop token
+   "request_id": str | None,    # idempotency key: a retried request
+                                # re-attaches to the live sequence
+   "emit_from": int | None}     # first generation index to emit —
+                                # the resume cursor for proxy retries
+Each streamed item is {"i": <first generation index>, "tokens":
+[<id>, ...], "done": <bool>} — items COALESCE every token generated
+since the consumer last drained (the decode loop outruns the per-item
+transport under load), and the integer "i" is what makes the stream
+RESUMABLE: after a mid-stream replica death the HTTP proxy re-submits
+with ``emit_from`` = last delivered index + 1 and the client sees at
+most one duplicated token boundary.
+
+Admission is a bounded head-of-line queue: a full queue (or a prompt
+that can never fit the page budget) raises :class:`LLMOverloadedError`,
+which the proxy maps to the PR-3 503 shed gate.  Sequences whose
+consumer vanished (SSE disconnect -> generator cancel) keep their pages
+only for ``llm_detach_grace_s`` — the re-attach window for transparent
+resume — then are cancelled and recycled.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = ["LLMEngine", "LLMOverloadedError", "llm_deployment",
+           "run_llm_loop"]
+
+
+class LLMOverloadedError(RuntimeError):
+    """Admission shed: queue full or the prompt cannot be paged in.
+    The HTTP proxy maps this to 503 (the serve shed-gate contract)."""
+
+
+# sequence states
+_QUEUED = "queued"
+_PREFILL = "prefill"
+_DECODE = "decode"
+
+_forward_cache: Dict[int, Any] = {}
+
+
+def _jit_forward(model, params, k, v, tokens, slots, ctx, ctx_pos,
+                 ctx_mask, q_pos, last_idx):
+    """One forward over the paged cache -> (greedy next tokens at
+    ``last_idx``, updated pools).  Jitted ONCE per (model, shapes) —
+    the flax module is a hashable static argument, so every engine
+    instance with the same config shares the compiled executable
+    (k/v pools donated: in-place cache updates)."""
+    import jax
+
+    fn = _forward_cache.get(0)
+    if fn is None:
+        import jax.numpy as jnp
+
+        def _fwd(model, params, k, v, tokens, slots, ctx, ctx_pos,
+                 ctx_mask, q_pos, last_idx):
+            logits, pools = model.apply(
+                {"params": params}, tokens,
+                {"k": k, "v": v, "slots": slots, "ctx": ctx,
+                 "ctx_pos": ctx_pos, "ctx_mask": ctx_mask,
+                 "q_pos": q_pos})
+            picked = jnp.take_along_axis(
+                logits, last_idx[:, None, None], axis=1)[:, 0]
+            return jnp.argmax(picked, axis=-1), pools
+
+        fn = _forward_cache[0] = jax.jit(
+            _fwd, static_argnums=0, donate_argnums=(2, 3))
+    return fn(model, params, k, v, tokens, slots, ctx, ctx_pos, ctx_mask,
+              q_pos, last_idx)
+
+
+class _Seq:
+    __slots__ = ("request_id", "prompt", "prefill_tokens", "generated",
+                 "max_new", "eos", "block_table", "pos", "state", "done",
+                 "error", "attach_count", "detached_at", "done_at",
+                 "submitted_at", "first_token_at", "cancelled",
+                 "slot_cache", "cond")
+
+    def __init__(self, request_id: str, prompt: List[int], max_new: int,
+                 eos: Optional[int], preknown: Optional[List[int]] = None):
+        self.request_id = request_id
+        # physical slot per position, vectorized at admission (the
+        # decode hot path slices this instead of re-deriving slots in
+        # Python per lane per step); cond is per-sequence so a token
+        # emit wakes THIS stream's consumer, not every parked thread
+        self.slot_cache = None
+        self.cond: Optional[threading.Condition] = None
+        self.prompt = list(prompt)
+        self.generated: List[int] = list(preknown or [])
+        # restored sequences re-prefill prompt + already-known tokens in
+        # one pass; fresh sequences prefill just the prompt
+        self.prefill_tokens = self.prompt + self.generated
+        self.max_new = int(max_new)
+        self.eos = eos
+        self.block_table: List[int] = []
+        self.pos = 0                  # tokens whose KV is in the cache
+        self.state = _QUEUED
+        self.done = False
+        self.error: Optional[BaseException] = None
+        self.attach_count = 0
+        self.detached_at: Optional[float] = None
+        self.done_at: Optional[float] = None
+        self.submitted_at = time.monotonic()
+        self.first_token_at: Optional[float] = None
+        self.cancelled = False
+
+    @property
+    def total_len(self) -> int:
+        return len(self.prompt) + self.max_new
+
+
+class LLMEngine:
+    """Continuous-batching decode engine over a paged KV cache.
+
+    One engine per replica.  The pinned loop (``run_loop``) is the ONLY
+    caller of ``step()`` in serving; request threads touch the engine
+    only through ``submit``/``iter_tokens``/``release`` under the
+    engine lock.  (The static-batching bench baseline instead drives
+    ``generate_batch`` inline — an engine is stepped by its loop OR
+    inline, never both.)
+
+    Paging: the cache is ``num_pages`` pages of ``page_size`` slots per
+    layer; page 0 is reserved as the garbage page for inactive batch
+    lanes and prefill padding.  A sequence's pages are allocated
+    UP FRONT for prompt + max_new at admission (no mid-decode OOM, at
+    the cost of reserving its worst case) and recycled the moment it
+    finishes, errors, or is cancelled.
+    """
+
+    def __init__(self, cfg=None, *, model: Any = "tiny",
+                 params: Any = None, seed: int = 0,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 max_batch: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 detach_grace_s: Optional[float] = None,
+                 prefill_lanes: Optional[int] = None,
+                 stream_flush_tokens: Optional[int] = None,
+                 dtype: Any = None):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu._private.config import config
+        from ray_tpu.models.llama import LlamaConfig, LlamaModel, \
+            make_kv_pools
+
+        self._np = np
+        if cfg is None:
+            if isinstance(model, LlamaConfig):
+                cfg = model
+            elif isinstance(model, dict):
+                cfg = LlamaConfig(**model)
+            else:
+                cfg = getattr(LlamaConfig, str(model))()
+        if dtype is not None:
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, dtype=dtype)
+        self.cfg = cfg
+        self.page_size = int(page_size or config.llm_page_size)
+        self.max_batch = int(max_batch or config.llm_max_batch_size)
+        self.prefill_chunk = int(prefill_chunk or config.llm_prefill_chunk)
+        self.max_queue = int(max_queue or config.llm_admission_queue)
+        self.detach_grace_s = float(
+            detach_grace_s if detach_grace_s is not None
+            else config.llm_detach_grace_s)
+        self.prefill_lanes = max(1, min(
+            int(prefill_lanes or config.llm_prefill_lanes),
+            self.max_batch))
+        self.stream_flush_tokens = max(1, int(
+            stream_flush_tokens or config.llm_stream_flush_tokens))
+        self.pages_per_seq = -(-cfg.max_seq_len // self.page_size)
+        if num_pages is None:
+            num_pages = int(config.llm_kv_pages) or (
+                1 + self.max_batch * self.pages_per_seq)
+        # +1: page 0 is the garbage page, never allocated
+        self.num_pages = max(int(num_pages), 2)
+        self.ctx_len = self.pages_per_seq * self.page_size
+
+        self._model = LlamaModel(cfg)
+        if params is None:
+            dummy = np.zeros((1, 8), np.int32)
+            params = self._model.init(
+                jax.random.PRNGKey(int(seed)), dummy)["params"]
+        self._params = params
+        self._pools = make_kv_pools(cfg, self.num_pages * self.page_size)
+        # the jitted stepper is shared process-wide (_jit_forward keys
+        # on the STATIC model + shapes): every engine with the same
+        # config/pool geometry reuses one executable — two compiles
+        # total in steady state (decode [B,1] and prefill [1,C])
+        self._step_fn = _jit_forward
+
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._free_pages: List[int] = list(range(1, self.num_pages))
+        self._queued: deque = deque()
+        self._active: List[_Seq] = []
+        self._by_rid: Dict[str, _Seq] = {}
+        self._stopped = threading.Event()
+        self._loop_running = False
+        self._arange = np.arange(self.ctx_len, dtype=np.int32)
+        self._steps = 0
+        self._cancelled_total = 0
+        self._last_batch = 0
+        self._last_step_tokens = 0
+        self._metrics = None
+        self._warm = False
+
+    # ------------------------------------------------------------ admission
+
+    def submit(self, request: Dict[str, Any]) -> _Seq:
+        """Admit (or re-attach to) one sequence.  Raises
+        LLMOverloadedError when the admission queue is full, ValueError
+        on requests that can never fit."""
+        import uuid
+
+        if not isinstance(request, dict) or not request.get("tokens"):
+            raise ValueError("llm request must be a dict with 'tokens'")
+        prompt = [int(t) for t in request["tokens"]]
+        max_new = int(request.get("max_new_tokens", 16))
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        eos = request.get("eos")
+        eos = int(eos) if eos is not None else None
+        rid = str(request.get("request_id") or uuid.uuid4().hex[:16])
+        with self._lock:
+            seq = self._by_rid.get(rid)
+            if seq is not None and seq.cancelled:
+                # a grace-swept/cancelled sequence is TRUNCATED — a
+                # retry must re-generate, not replay a partial result
+                # presented as done
+                del self._by_rid[rid]
+                seq = None
+            if seq is not None:
+                # idempotent re-attach: a proxy retry after replica or
+                # connection trouble resumes the SAME sequence (replay
+                # of already-generated tokens + live continuation)
+                seq.attach_count += 1
+                seq.detached_at = None
+                return seq
+            if len(prompt) + max_new > min(self.cfg.max_seq_len,
+                                           self.ctx_len):
+                raise ValueError(
+                    f"prompt+max_new_tokens = {len(prompt) + max_new} "
+                    f"exceeds max_seq_len {self.cfg.max_seq_len}")
+            pages_needed = -(-(len(prompt) + max_new) // self.page_size)
+            if pages_needed > self.num_pages - 1:
+                raise LLMOverloadedError(
+                    f"request needs {pages_needed} KV pages; replica "
+                    f"has {self.num_pages - 1}")
+            if len(self._queued) >= self.max_queue:
+                raise LLMOverloadedError(
+                    f"admission queue full ({self.max_queue})")
+            seq = _Seq(rid, prompt, max_new, eos)
+            seq.cond = threading.Condition(self._lock)
+            seq.attach_count = 1
+            self._by_rid[rid] = seq
+            self._queued.append(seq)
+            self._cond.notify_all()  # wake the parked decode loop
+        return seq
+
+    def iter_tokens(self, seq: _Seq, emit_from: int = 0):
+        """Blocking generator of token items for one consumer.
+
+        Items are COALESCED: each carries every token generated since
+        the consumer last drained (``{"i": <first index>, "tokens":
+        [...], "done": bool}``) — under load the decode loop outruns
+        the per-item streaming path (one stream push + one ref
+        resolution + one SSE chunk each), so batching tokens into items
+        is what lets 64+ concurrent streams ride one engine without the
+        transport dominating.  TTFT is unaffected: the first item
+        leaves the moment the first token exists.  Parked waits rely on
+        per-sequence notifies and re-check every 2s — that bound (not
+        the next token) is the worst-case latency for a pending
+        cancellation async-exc on an idle consumer; an actively-fed
+        consumer sees it within one flush interval."""
+        i = max(0, int(emit_from))
+        first = True
+        while True:
+            with self._cond:
+                while True:
+                    if seq.error is not None:
+                        raise seq.error
+                    n = len(seq.generated)
+                    if seq.done and i >= n:
+                        return
+                    # the FIRST item flushes on one token (TTFT);
+                    # after that, wait for stream_flush_tokens (or the
+                    # end) so a fast decode loop doesn't pay the
+                    # push+resolve+chunk transport per single token
+                    flush = 1 if first else self.stream_flush_tokens
+                    if n - i >= flush or (seq.done and n > i):
+                        item = {"i": i, "tokens": list(seq.generated[i:n]),
+                                "done": bool(seq.done)}
+                        break
+                    # per-seq notifies (flush boundaries, finish,
+                    # cancel) do the real waking; the 2s timeout only
+                    # bounds how long a pending cancellation async-exc
+                    # can sit on a parked thread.  A short poll here
+                    # melts down at scale: 256 parked streams polling
+                    # at 10Hz is ~2.5k futex syscalls/s
+                    (seq.cond or self._cond).wait(2.0)
+            yield item
+            first = False
+            if item["done"]:
+                return
+            i = n
+
+    def release(self, seq: _Seq) -> None:
+        """One consumer detached (finished, disconnected, cancelled).
+        The last detach of an unfinished sequence starts the grace
+        clock; past it the loop cancels the sequence and recycles its
+        pages instead of decoding to max_seq_len for nobody."""
+        with self._lock:
+            seq.attach_count = max(0, seq.attach_count - 1)
+            if seq.attach_count == 0 and not seq.done:
+                seq.detached_at = time.monotonic()
+
+    def cancel(self, request_id: str) -> bool:
+        with self._lock:
+            seq = self._by_rid.get(request_id)
+            if seq is None or seq.done:
+                return False
+            self._finish_seq(seq, cancelled=True)
+            self._cond.notify_all()
+            return True
+
+    # ------------------------------------------------------------- stepping
+
+    def _alloc_pages(self, n: int) -> List[int]:
+        pages = self._free_pages[:n]
+        del self._free_pages[:n]
+        return pages
+
+    def _finish_seq(self, seq: _Seq, cancelled: bool = False) -> None:
+        """Lock held.  Mark done and recycle pages immediately."""
+        seq.done = True
+        seq.cancelled = cancelled
+        if cancelled:
+            self._cancelled_total += 1
+        seq.done_at = time.monotonic()
+        if seq.cond is not None:
+            seq.cond.notify_all()
+        self._free_pages.extend(seq.block_table)
+        seq.block_table = []
+        if seq in self._active:
+            self._active.remove(seq)
+        try:
+            self._queued.remove(seq)
+        except ValueError:
+            pass
+
+    def _slot(self, seq: _Seq, pos: int) -> int:
+        return (seq.block_table[pos // self.page_size] * self.page_size
+                + pos % self.page_size)
+
+    def _sweep(self, now: float) -> None:
+        """Lock held: cancel sequences abandoned past the grace window
+        and forget finished ones past the replay TTL."""
+        from ray_tpu._private.config import config
+
+        for seq in list(self._active) + list(self._queued):
+            if (seq.attach_count == 0 and seq.detached_at is not None
+                    and now - seq.detached_at > self.detach_grace_s):
+                self._finish_seq(seq, cancelled=True)
+        ttl = float(config.llm_done_seq_ttl_s)
+        for rid, seq in list(self._by_rid.items()):
+            if seq.done and seq.done_at is not None \
+                    and now - seq.done_at > ttl:
+                del self._by_rid[rid]
+
+    def _admit_locked(self) -> None:
+        while self._queued and len(self._active) < self.max_batch:
+            seq = self._queued[0]
+            pages = -(-seq.total_len // self.page_size)
+            if pages > len(self._free_pages):
+                break  # head-of-line waits for pages to recycle
+            self._queued.popleft()
+            seq.block_table = self._alloc_pages(pages)
+            np = self._np
+            bt = np.asarray(seq.block_table, np.int64)
+            seq.slot_cache = (np.repeat(bt * self.page_size,
+                                        self.page_size)
+                              + np.tile(np.arange(self.page_size),
+                                        len(bt))).astype(np.int32)
+            seq.state = _PREFILL
+            self._active.append(seq)
+
+    def _emit_token(self, seq: _Seq, token: int) -> None:
+        """Lock held: append one generated token, finish on EOS/budget,
+        and wake THIS sequence's consumer at flush boundaries only —
+        an engine-wide notify_all per step would thundering-herd every
+        parked stream thread per token."""
+        seq.generated.append(int(token))
+        n = len(seq.generated)
+        if seq.first_token_at is None:
+            seq.first_token_at = time.monotonic()
+            m = self.metrics()
+            if m is not None:
+                m["ttft"].observe(seq.first_token_at - seq.submitted_at)
+        if (seq.eos is not None and int(token) == seq.eos) \
+                or n >= seq.max_new:
+            self._finish_seq(seq)
+        elif seq.cond is not None \
+                and (n - 1) % self.stream_flush_tokens == 0:
+            # aligned with the consumer cursor AFTER the n=1 TTFT item
+            # (i=1): wakes land exactly when a full flush quota exists
+            # past it (n = 1, F+1, 2F+1, ...), not one window late
+            seq.cond.notify_all()
+
+    def step(self) -> bool:
+        """One engine iteration: admit, one prefill chunk, one decode
+        pass over every decoding sequence.  Returns False when there was
+        nothing to do (the loop then parks on the condition)."""
+        np = self._np
+        now = time.monotonic()
+        with self._lock:
+            self._sweep(now)
+            self._admit_locked()
+            prefills = [s for s in self._active
+                        if s.state == _PREFILL][:self.prefill_lanes]
+            decode = [s for s in self._active if s.state == _DECODE]
+            if not prefills and not decode:
+                self._last_batch = 0
+                self._last_step_tokens = 0
+                self._set_gauges()  # idle must publish zeros, not
+                # freeze the last busy step's values into the ring
+                return False
+            prefill_args = []
+            for seq in prefills:
+                lo = seq.pos
+                hi = min(lo + self.prefill_chunk, len(seq.prefill_tokens))
+                prefill_args.append(
+                    (seq, lo, hi, seq.prefill_tokens[lo:hi],
+                     seq.slot_cache[lo:hi], seq.slot_cache[:hi]))
+            decode_args = []
+            for seq in decode[:self.max_batch]:
+                last = (seq.generated[-1] if seq.generated
+                        else seq.prefill_tokens[-1])
+                decode_args.append(
+                    (seq, last, seq.slot_cache[seq.pos],
+                     seq.slot_cache[:seq.pos + 1]))
+        step_tokens = 0
+        # ---- chunked prefill, batched across lanes: up to
+        # prefill_lanes sequences advance one chunk each per step — a
+        # burst of N admissions costs N/lanes steps, while a LONG
+        # prompt still shares the loop with in-flight decodes instead
+        # of monopolizing it
+        if prefill_args:
+            lanes = self.prefill_lanes
+            c = self.prefill_chunk
+            tokens = np.zeros((lanes, c), np.int32)
+            slot_arr = np.zeros((lanes, c), np.int32)
+            ctx = np.zeros((lanes, self.ctx_len), np.int32)
+            ctx_pos = np.zeros((lanes, self.ctx_len), np.int32)
+            ctx_mask = np.zeros((lanes, self.ctx_len), bool)
+            q_pos = np.zeros((lanes, c), np.int32)
+            last_idx = np.zeros((lanes,), np.int32)
+            for lane, (seq, lo, hi, toks, slots, ctx_slots) \
+                    in enumerate(prefill_args):
+                tokens[lane, :hi - lo] = toks
+                slot_arr[lane, :hi - lo] = slots
+                ctx[lane, :hi] = ctx_slots
+                ctx_pos[lane, :hi] = self._arange[:hi]
+                ctx_mask[lane, :hi] = True
+                q_pos[lane, :hi - lo] = self._arange[lo:hi]
+                last_idx[lane] = hi - lo - 1
+            next_tok, self._pools = self._step_fn(
+                self._model, self._params, self._pools["k"],
+                self._pools["v"], tokens,
+                slot_arr, ctx, ctx_pos, ctx_mask, q_pos, last_idx)
+            next_tok = np.asarray(next_tok)
+            chunk_tokens = sum(hi - lo for _s, lo, hi, *_r in prefill_args)
+            step_tokens += chunk_tokens
+            with self._lock:
+                for lane, (seq, lo, hi, *_rest) in enumerate(prefill_args):
+                    if seq.done:
+                        continue  # cancelled mid-chunk: pages already back
+                    seq.pos = hi
+                    if hi == len(seq.prefill_tokens):
+                        seq.state = _DECODE
+                        self._emit_token(seq, int(next_tok[lane]))
+            m = self.metrics()
+            if m is not None:
+                m["tokens"].inc(chunk_tokens, tags={"phase": "prefill"})
+        # ---- token-level decode batch
+        if decode_args:
+            b = self.max_batch
+            tokens = np.zeros((b, 1), np.int32)
+            slot_arr = np.zeros((b, 1), np.int32)
+            ctx = np.zeros((b, self.ctx_len), np.int32)
+            ctx_pos = np.zeros((b, self.ctx_len), np.int32)
+            ctx_mask = np.zeros((b, self.ctx_len), bool)
+            q_pos = np.zeros((b, 1), np.int32)
+            last_idx = np.zeros((b,), np.int32)
+            for lane, (seq, last, slot, ctx_slots) in enumerate(decode_args):
+                tokens[lane, 0] = last
+                slot_arr[lane, 0] = slot
+                n = len(ctx_slots)
+                ctx[lane, :n] = ctx_slots
+                ctx_pos[lane, :n] = self._arange[:n]
+                ctx_mask[lane, :n] = True
+                q_pos[lane, 0] = seq.pos
+            next_tok, self._pools = self._step_fn(
+                self._model, self._params, self._pools["k"], self._pools["v"], tokens,
+                slot_arr, ctx, ctx_pos, ctx_mask, q_pos, last_idx)
+            next_tok = np.asarray(next_tok)
+            with self._lock:
+                for lane, (seq, _last, _slot, _ctx) in enumerate(decode_args):
+                    if seq.done:
+                        continue  # cancelled while we computed
+                    seq.pos += 1
+                    self._emit_token(seq, int(next_tok[lane]))
+            step_tokens += len(decode_args)
+            m = self.metrics()
+            if m is not None:
+                m["tokens"].inc(len(decode_args), tags={"phase": "decode"})
+        self._steps += 1
+        self._last_batch = len(decode_args)
+        self._last_step_tokens = step_tokens
+        self._set_gauges()
+        return True
+
+    def run_loop(self) -> Dict[str, Any]:
+        """The pinned decode loop: step while there is work, park on the
+        engine condition while idle.  Single-flight — a second install
+        (controller restart re-ensuring loops) returns immediately."""
+        with self._lock:
+            if self._loop_running:
+                return {"already_running": True}
+            self._loop_running = True
+        try:
+            while not self._stopped.is_set():
+                if not self.step():
+                    with self._cond:
+                        if not self._queued and not self._active:
+                            self._cond.wait(0.05)
+            return {"steps": self._steps}
+        except BaseException as e:
+            # a broken engine must fail its consumers, not hang them
+            with self._lock:
+                for seq in list(self._active) + list(self._queued):
+                    if not seq.done:
+                        seq.error = e
+                        self._finish_seq(seq, cancelled=True)
+                        if seq.cond is not None:
+                            seq.cond.notify_all()
+                self._cond.notify_all()
+            raise
+        finally:
+            with self._lock:
+                self._loop_running = False
+
+    def stop(self) -> None:
+        self._stopped.set()
+        with self._cond:
+            self._cond.notify_all()
+
+    # ------------------------------------------------- sync (bench baseline)
+
+    def generate_batch(self, requests: List[Dict[str, Any]]
+                       ) -> List[List[int]]:
+        """Static batching: admit the whole batch, run it to completion,
+        disband — the ``@serve.batch`` baseline the continuous path is
+        benched against.  Only for engines with no pinned loop."""
+        seqs = []
+        try:
+            for r in requests:
+                seqs.append(self.submit(r))
+        except BaseException:
+            # a failed admission mid-list must not strand the earlier
+            # sequences: nothing will ever drive or consume them, so
+            # they would hold pages and decode for nobody
+            with self._lock:
+                for s in seqs:
+                    self._finish_seq(s, cancelled=True)
+            raise
+        while any(not s.done for s in seqs):
+            if not self.step():
+                time.sleep(0.001)
+        for s in seqs:
+            self.release(s)
+        return [list(s.generated) for s in seqs]
+
+    # ------------------------------------------------------- observability
+
+    def metrics(self):
+        if self._metrics is None:
+            try:
+                from ray_tpu._private.metrics import llm_metrics
+
+                tokens, pages, batch, ttft, queue, tps = llm_metrics()
+                self._metrics = {"tokens": tokens, "pages": pages,
+                                 "batch": batch, "ttft": ttft,
+                                 "queue": queue, "tps": tps}
+            except Exception:
+                return None
+        return self._metrics
+
+    def _set_gauges(self) -> None:
+        m = self.metrics()
+        if m is None:
+            return
+        m["pages"].set(self.num_pages - 1 - len(self._free_pages),
+                       tags={"state": "used"})
+        m["pages"].set(len(self._free_pages), tags={"state": "free"})
+        m["batch"].set(self._last_batch)
+        m["queue"].set(len(self._queued))
+        m["tps"].set(self._last_step_tokens)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"steps": self._steps,
+                    "queued": len(self._queued),
+                    "active": len(self._active),
+                    "cancelled": self._cancelled_total,
+                    "live_seqs": len(self._by_rid),
+                    "free_pages": len(self._free_pages),
+                    "used_pages": self.num_pages - 1 - len(self._free_pages),
+                    "loop_running": self._loop_running,
+                    "last_batch": self._last_batch}
+
+    # ------------------------------------------------------- save / restore
+
+    def save_state(self) -> Dict[str, Any]:
+        """Snapshot of in-flight sequences for ``__rt_save__``: prompt +
+        tokens generated so far.  Tiny (token ids only) — params and KV
+        pages are reconstructed, not saved."""
+        with self._lock:
+            seqs = []
+            for seq in list(self._active) + list(self._queued):
+                if seq.done:
+                    continue
+                seqs.append({"request_id": seq.request_id,
+                             "tokens": list(seq.prompt),
+                             "generated": list(seq.generated),
+                             "max_new_tokens": seq.max_new,
+                             "eos": seq.eos})
+            return {"seqs": seqs}
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        """Re-admit saved sequences: each re-prefills prompt + known
+        tokens and continues decoding.  Consumers re-attach by
+        request_id within the grace window (their ``emit_from`` skips
+        what they already saw)."""
+        now = time.monotonic()
+        with self._lock:
+            for s in (state or {}).get("seqs", []):
+                rid = s["request_id"]
+                if rid in self._by_rid:
+                    continue
+                seq = _Seq(rid, s["tokens"], s["max_new_tokens"],
+                           s.get("eos"), preknown=s.get("generated"))
+                seq.cond = threading.Condition(self._lock)
+                if len(seq.generated) >= seq.max_new:
+                    continue  # finished before the snapshot landed
+                seq.detached_at = now  # grace window for re-attach
+                self._by_rid[rid] = seq
+                self._queued.append(seq)
+            self._cond.notify_all()
+
+
+# ----------------------------------------------------------- replica target
+
+
+class _LLMCallable:
+    """The deployment target hosted by each ``llm_deployment`` replica.
+
+    ``__call__`` is the streaming endpoint: it admits the request and
+    yields token items as the PINNED loop (installed by the controller
+    through ``__rt_dag_llm_loop__``) produces them.  The generator's
+    finally detaches the consumer, so an abandoned stream (SSE
+    disconnect -> generator cancel) frees its KV pages after the grace
+    window instead of decoding to max_seq_len."""
+
+    def __init__(self, warm: bool = True, **engine_kwargs):
+        self._engine = LLMEngine(**engine_kwargs)
+        if warm:
+            # compile both jitted shapes (prefill chunk + decode) HERE,
+            # inside the replica constructor: the deploy health gate
+            # (serve_replica_health_timeout_s) covers it, so the first
+            # real request never pays ~seconds of XLA compile while
+            # reconcile health probes run against their 5s timeout
+            self._engine.generate_batch(
+                [{"tokens": [1], "max_new_tokens": 2}])
+
+    def __call__(self, request):
+        emit_from = 0
+        if isinstance(request, dict):
+            emit_from = int(request.get("emit_from") or 0)
+        seq = self._engine.submit(request)
+        try:
+            yield from self._engine.iter_tokens(seq, emit_from)
+        finally:
+            self._engine.release(seq)
+
+    def generate(self, request):
+        """Non-streaming convenience: the full generation as one list
+        (still continuous-batched with everything else in flight)."""
+        toks: List[int] = []
+        for item in self(request):
+            toks.extend(item["tokens"])
+        return {"request_id": None, "tokens": toks}
+
+    def stats(self):
+        return self._engine.stats()
+
+    def __rt_save__(self):
+        return self._engine.save_state()
+
+    def __rt_restore__(self, state):
+        self._engine.restore_state(state)
+
+
+class _LLMBatchCallable:
+    """The ``@serve.batch`` STATIC-batching baseline for bench A/B:
+    requests coalesce into a fixed batch, the whole batch generates to
+    completion in one call, then disbands — the exact re-dispatching
+    shape continuous batching replaces.
+
+    ``__call__`` serves the SAME streaming contract as the continuous
+    path (SSE items of <= stream_flush_tokens tokens) so the A/B
+    measures the batching policy, not response framing — but a static
+    batch can only start emitting once the WHOLE batch finished, which
+    is precisely the TTFT/utilization gap continuous batching closes."""
+
+    def __init__(self, max_batch_size: int = 8,
+                 batch_wait_timeout_s: float = 0.005, warm: bool = True,
+                 **engine_kwargs):
+        from ray_tpu.serve.api import batch
+
+        self._engine = LLMEngine(**engine_kwargs)
+        if warm:
+            self._engine.generate_batch(
+                [{"tokens": [1], "max_new_tokens": 2}])
+        self._gen = batch(self._run_batch,
+                          max_batch_size=max_batch_size,
+                          batch_wait_timeout_s=batch_wait_timeout_s)
+
+    def _run_batch(self, requests):
+        return self._engine.generate_batch(requests)
+
+    def __call__(self, request):
+        toks = self._gen(request)  # blocks until this request's batch ends
+        flush = self._engine.stream_flush_tokens
+        for i in range(0, len(toks), flush):
+            yield {"i": i, "tokens": toks[i:i + flush],
+                   "done": i + flush >= len(toks)}
+
+
+def run_llm_loop(worker, instance, *_args) -> Dict[str, Any]:
+    """Worker-side entry for the ``__rt_dag_llm_loop__`` system method
+    (see CoreWorker._execute_inner): pins this exec thread to the
+    replica engine's decode loop until the replica dies."""
+    target = getattr(instance, "_callable", instance)
+    engine = getattr(target, "_engine", None)
+    if not isinstance(engine, LLMEngine):
+        raise TypeError(
+            "__rt_dag_llm_loop__ requires an llm_deployment replica "
+            f"(got {type(target).__name__})")
+    return engine.run_loop()
+
+
+def llm_deployment(name: str = "llm", *, num_replicas: int = 1,
+                   max_ongoing_requests: int = 64,
+                   ray_actor_options: Optional[Dict[str, Any]] = None,
+                   **engine_kwargs):
+    """Build an LLM serving Application: replicas host an
+    :class:`LLMEngine` and the controller installs the pinned decode
+    loop on each one.  ``engine_kwargs`` go to :class:`LLMEngine`
+    (model=, page_size=, num_pages=, max_batch=, prefill_chunk=,
+    max_queue=, seed=, detach_grace_s=); unset knobs fall back to the
+    ``llm_*`` config defaults.
+
+    Usage::
+
+        app = serve.llm_deployment("chat", model="tiny", max_batch=16)
+        handle = serve.run(app)
+        # stream over HTTP: POST /chat with Accept: text/event-stream
+    """
+    from ray_tpu.serve.api import Deployment
+
+    d = Deployment(_LLMCallable, name, num_replicas=num_replicas,
+                   max_ongoing_requests=max_ongoing_requests,
+                   ray_actor_options=dict(ray_actor_options or {}),
+                   llm=True)
+    return d.bind(**engine_kwargs)

@@ -339,7 +339,8 @@ def test_metric_names_documented_in_readme(cluster):
                m.object_leaked_bytes_gauge,
                m.memory_scan_partial_gauge,
                m.object_store_breakdown_gauge,
-               m.pipeline_metrics):
+               m.pipeline_metrics,
+               m.llm_metrics):
         fn()
     with m.default_registry._lock:
         names |= set(m.default_registry._metrics)

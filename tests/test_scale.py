@@ -23,14 +23,16 @@ def cluster():
         ray_tpu.shutdown()
 
 
+@pytest.mark.slow
 def test_many_queued_tasks_drain(cluster):
     """Tens of thousands of tasks queued at once all complete
     (reference: '1M tasks queued on one node' scaled to the box) — the
     batched submit path (one push_tasks frame per lease pass, batched
     lease asks) is what makes this a queueing test instead of a
-    frame-count test.  10k ≈ 17s on the 2-CPU CI box, sized so the full
-    tier-1 suite stays inside its 870s budget; the 50k envelope runs
-    under the slow marker."""
+    frame-count test.  Moved behind `slow` with the 50k envelope (which
+    subsumes it) when the LLM serving tests joined tier-1 — the 870s
+    budget was at ~796s; tier-1 keeps the 10k-ref single-get and the
+    24-actor envelope below as its scale gates."""
     @ray_tpu.remote
     def unit(i):
         return i
