@@ -1111,6 +1111,166 @@ def _chaos_bench(total_s=9.0, kill_at_s=2.5, conns=8):
         cluster.shutdown()
 
 
+def _autoscale_bench(total_s=18.0, conns=16):
+    """Runs as a subprocess: a 1-node AutoscalingCluster (head only),
+    Serve deployment with num_replicas="auto" whose replicas can only
+    land on autoscaled worker nodes, ramped HTTP load.  The replica
+    autoscaler scales on ongoing requests, replica infeasibility parks
+    as PENDING-actor demand, the node autoscaler launches workers to
+    resolve it, and when the load stops the fleet drains back through
+    the graceful-drain state machine.  Reports availability over the
+    WHOLE run (incl. both scale events), p99 latency, and the
+    scale-up / drain latencies."""
+    import asyncio
+    import threading
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.cluster_utils import AutoscalingCluster
+
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 2},
+        worker_node_types={
+            "serve-worker": {"resources": {"CPU": 2}, "min_workers": 0,
+                             "max_workers": 3}},
+        idle_timeout_s=1.5, update_period_s=0.3)
+    ray_tpu.init(address=cluster.address)
+    try:
+        @serve.deployment(name="auto_echo", num_replicas="auto",
+                          max_ongoing_requests=32,
+                          autoscaling_config={
+                              "min_replicas": 1, "max_replicas": 3,
+                              "target_ongoing_requests": 2,
+                              "upscale_consecutive": 2,
+                              # longer than any mid-load ongoing dip:
+                              # the drain event the phase measures is
+                              # the one AFTER the load stops
+                              "downscale_delay_s": 8.0},
+                          ray_actor_options={"num_cpus": 2})
+        def auto_echo(x):
+            time.sleep(0.02)  # enough service time to sustain ongoing
+            return {"ok": 1}
+
+        serve.run(auto_echo.bind())  # first replica = first node launch
+        host, port = serve.start_http()
+        _serve_http_get(host, port, 2, 20, "/auto_echo?x=1")  # warm
+
+        results = []  # (t_rel, ok, latency_s)
+        t0 = time.perf_counter()
+        scale_up_done = [0.0]
+        drain_done = [0.0]
+        peak_nodes = [0]
+        baseline_nodes = len(cluster.provider.non_terminated_nodes())
+
+        def watcher():
+            # scale-up latency: load start -> a SECOND worker node live;
+            # drain latency: load stop -> fleet back at one node
+            while time.perf_counter() - t0 < total_s + 90:
+                n = len(cluster.provider.non_terminated_nodes())
+                peak_nodes[0] = max(peak_nodes[0], n)
+                tr = time.perf_counter() - t0
+                if not scale_up_done[0] and n > baseline_nodes:
+                    scale_up_done[0] = tr
+                if tr > total_s and scale_up_done[0] \
+                        and n <= baseline_nodes:
+                    drain_done[0] = tr
+                    return
+                time.sleep(0.1)
+
+        async def client():
+            req = b"GET /auto_echo?x=1 HTTP/1.1\r\nHost: bench\r\n\r\n"
+            while time.perf_counter() - t0 < total_s:
+                try:
+                    reader, writer = await asyncio.open_connection(host,
+                                                                   port)
+                except OSError:
+                    results.append((time.perf_counter() - t0, False, 0.0))
+                    await asyncio.sleep(0.05)
+                    continue
+                try:
+                    while time.perf_counter() - t0 < total_s:
+                        ts = time.perf_counter()
+                        writer.write(req)
+                        await writer.drain()
+                        status = await reader.readline()
+                        if not status:
+                            results.append((ts - t0, False, 0.0))
+                            break
+                        clen = 0
+                        while True:
+                            h = await reader.readline()
+                            if h in (b"\r\n", b"\n", b""):
+                                break
+                            if h.lower().startswith(b"content-length:"):
+                                clen = int(h.split(b":", 1)[1])
+                        if clen:
+                            await reader.readexactly(clen)
+                        results.append(
+                            (ts - t0, b"200" in status,
+                             time.perf_counter() - ts))
+                except (OSError, asyncio.IncompleteReadError):
+                    results.append((time.perf_counter() - t0, False, 0.0))
+                finally:
+                    try:
+                        writer.close()
+                    except Exception:
+                        pass
+
+        async def drive():
+            await asyncio.wait_for(
+                asyncio.gather(*[client() for _ in range(conns)],
+                               return_exceptions=True),
+                timeout=total_s + 60)
+
+        wt = threading.Thread(target=watcher, daemon=True)
+        wt.start()
+        asyncio.run(drive())
+        wt.join(timeout=120)
+        total = len(results)
+        ok = sum(1 for _, good, _ in results if good)
+        lats = sorted(dt for _, good, dt in results if good and dt > 0)
+        p99 = lats[min(len(lats) - 1, int(0.99 * len(lats)))] \
+            if lats else 0.0
+        out = {
+            "autoscale_requests_total": total,
+            "autoscale_availability_pct": round(
+                100.0 * ok / max(total, 1), 2),
+            "autoscale_p99_ms": round(p99 * 1000, 2),
+            "scale_up_latency_s": round(scale_up_done[0], 2)
+            if scale_up_done[0] else -1.0,
+            "drain_latency_s": round(drain_done[0] - total_s, 2)
+            if drain_done[0] else -1.0,
+            # +1: the head node is not provider-managed
+            "autoscale_peak_nodes": 1 + peak_nodes[0],
+        }
+        st = cluster.status()
+        out["autoscale_scale_ups"] = st["scale_up_total"]
+        out["autoscale_scale_downs"] = st["scale_down_total"]
+        print("AUTOSCALEJSON " + json.dumps(out))
+    finally:
+        try:
+            serve.shutdown_http()
+        except Exception:
+            pass
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
+def bench_autoscale_subprocess():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--autoscale-bench"],
+        capture_output=True, text=True, timeout=420, cwd=REPO)
+    for line in proc.stdout.splitlines():
+        if line.startswith("AUTOSCALEJSON "):
+            return json.loads(line[len("AUTOSCALEJSON "):])
+    raise RuntimeError(
+        f"autoscale bench rc={proc.returncode}: {proc.stderr[-400:]}")
+
+
 def bench_chaos_subprocess():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--chaos-bench"],
@@ -1386,6 +1546,10 @@ def main():
     # contract: chaos_availability_pct >= 99 (handle-level dead-replica
     # retry keeps clients whole while the controller re-heals)
     phase("chaos_recovery", lambda: extras.update(bench_chaos_subprocess()))
+    # autoscale: ramp Serve HTTP load against a 1-node autoscaling
+    # cluster; contract: autoscale_availability_pct >= 99 through both
+    # the scale-up and the drain-based scale-down event
+    phase("autoscale", lambda: extras.update(bench_autoscale_subprocess()))
 
     # pipeline phase: CPU-only subprocess cluster (2 MPMD stages over
     # channels vs the single-program baseline, best-of alternating pairs)
@@ -1416,6 +1580,9 @@ if __name__ == "__main__":
     elif "--chaos-bench" in sys.argv:
         sys.path.insert(0, REPO)
         _chaos_bench()
+    elif "--autoscale-bench" in sys.argv:
+        sys.path.insert(0, REPO)
+        _autoscale_bench()
     elif "--client-bench" in sys.argv:
         sys.path.insert(0, REPO)
         i = sys.argv.index("--client-bench")

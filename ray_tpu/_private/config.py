@@ -169,6 +169,36 @@ _def("llm_detach_grace_s", 2.0)     # KV pages survive a vanished consumer
 # this long (the re-attach window for proxy resume) before recycling
 _def("llm_done_seq_ttl_s", 30.0)    # finished sequences replayable (by
 # request_id) this long for duplicate/late retries
+# --- elastic autoscaling (see autoscaler/ + head drain state machine) --------
+# sustained-demand hysteresis: backlog (demand that FITS existing nodes
+# but queues behind busy capacity) must persist for this many
+# consecutive autoscaler passes before it launches nodes — one burst
+# that drains on its own must not thrash the cluster.  Demand NO
+# existing node can ever fit scales up immediately (waiting cannot
+# resolve infeasibility).
+_def("autoscaler_upscale_consecutive", 3)
+# graceful drain budget: past this the drain is abandoned (the node
+# keeps running; the autoscaler retries later) rather than force-killed
+_def("drain_timeout_s", 60.0)
+# how long a drained node's agent gets to finish in-flight leases
+# before the remaining (non-migratable) workers are cut loose
+_def("drain_lease_grace_s", 20.0)
+# scheduler-latency SLO pressure: queued-phase p99 above this for a
+# sustained window counts as scale-up pressure even without parked
+# infeasible demand (0 disables the signal)
+_def("autoscaler_sched_p99_threshold_ms", 0.0)
+# --- serve replica autoscaling (num_replicas="auto") -------------------------
+# target ongoing requests per replica before another replica is added
+_def("serve_autoscale_target_ongoing", 2)
+_def("serve_autoscale_min_replicas", 1)
+_def("serve_autoscale_max_replicas", 8)
+# upscale needs the computed desired above current for this many
+# consecutive reconcile rounds; downscale needs it below for this long
+_def("serve_autoscale_up_consecutive", 2)
+_def("serve_autoscale_down_delay_s", 10.0)
+# --- LLM sampling (jit-static decode knobs; see serve/llm.py) ----------------
+_def("llm_temperature", 0.0)  # 0 = greedy argmax (the decode-identity tier)
+_def("llm_top_k", 0)          # 0 = full vocab; >0 = sample among top-k
 # --- distributed tracing (see _private/tracing.py) ---------------------------
 _def("tracing_enabled", True)
 _def("trace_sampling_ratio", 1.0)      # root-span sampling probability

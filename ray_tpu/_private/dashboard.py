@@ -162,15 +162,28 @@ function table(headers, rows) {
 }
 
 const VIEWS = {
-  nodes: s => table(
-    ["node", "address", "role", "resources (avail/total)", "labels"],
-    s.nodes.map(n => [
-      el("code", {}, n.node_id.slice(0, 12)),
-      `${n.addr[0]}:${n.addr[1]}`,
-      n.is_head_node ? "head" : "worker",
-      fmtRes(n.resources || {}),
-      JSON.stringify(n.labels || {}),
-    ])),
+  nodes: s => {
+    const t = table(
+      ["node", "address", "role", "resources (avail/total)", "labels"],
+      s.nodes.map(n => [
+        el("code", {}, n.node_id.slice(0, 12)),
+        `${n.addr[0]}:${n.addr[1]}`,
+        n.is_head_node ? "head" : (n.draining ? chip("DRAINING") : "worker"),
+        fmtRes(n.resources || {}),
+        JSON.stringify(n.labels || {}),
+      ]));
+    const a = s.autoscaler || {};
+    const rep = a.report || {};
+    if (!rep.ts && !(a.draining || []).length) return t;
+    const line = el("p", {}, "autoscaler: pending launches " +
+      (rep.pending_launches || 0) + " · scale events up=" +
+      (rep.scale_up_total || 0) + " down=" + (rep.scale_down_total || 0) +
+      (rep.last_decision ? " · " + rep.last_decision : "") +
+      ((a.draining || []).length
+        ? " · draining " + a.draining.map(n => n.slice(0, 12)).join(", ")
+        : ""));
+    return el("div", {}, line, t);
+  },
   actors: s => table(
     ["id", "name", "state", "node", "restarts left"],
     s.actors.map(a => [

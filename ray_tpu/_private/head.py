@@ -134,7 +134,8 @@ class _ActorEntry:
 class _NodeEntry:
     __slots__ = ("node_id", "host", "port", "arena_path", "resources",
                  "last_heartbeat", "client", "is_head_node",
-                 "pending_demands", "labels", "xfer_port", "memory")
+                 "pending_demands", "labels", "xfer_port", "memory",
+                 "draining")
 
     def __init__(self, node_id: str, host: str, port: int, arena_path: str,
                  resources: NodeResources, is_head_node: bool,
@@ -159,6 +160,10 @@ class _NodeEntry:
         # latest store byte breakdown off this node's heartbeat — the
         # cheap (no fan-out) half of /api/memory and rtpu summary
         self.memory: Dict[str, Any] = {}
+        # graceful scale-down: a DRAINING node grants no new leases and
+        # is excluded from every placement decision; the drain state
+        # machine (HeadService._drain_task) owns the flag's lifecycle
+        self.draining = False
         # NOTE: object locations live in HeadService.dir (the sharded
         # object directory), no longer per-node snapshot maps here
 
@@ -171,6 +176,7 @@ class _NodeEntry:
             "is_head_node": self.is_head_node,
             "labels": self.labels,
             "xfer_port": self.xfer_port,
+            "draining": self.draining,
         }
 
 
@@ -214,6 +220,12 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         self.restarted = False  # loaded pre-existing state on boot
         # node types an autoscaler announced it can launch
         self._autoscaler_types: Dict[str, Dict[str, Any]] = {}
+        # elastic autoscaling: per-node graceful-drain records
+        # (node_id -> {state, phase, ...}; state=draining|drained|failed)
+        # plus the autoscaler's latest status report — together they are
+        # /api/autoscaler and the `rtpu status` autoscaler pane
+        self._drains: Dict[str, Dict[str, Any]] = {}
+        self._autoscaler_status: Dict[str, Any] = {}
         # task-event store: merged record per task, insertion-ordered so
         # the oldest fall off at the cap (reference: gcs_task_manager.h).
         # Incoming frames queue in _ev_inbox and merge once per loop
@@ -240,6 +252,12 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         # gcs_placement_group_manager.cc SchedulePendingPlacementGroups,
         # fired on resource-change events from the syncer)
         self._pg_wake_waiters: List[asyncio.Future] = []
+        # ditto for PENDING actors parked on "no feasible node": a node
+        # registration wakes them immediately instead of them sleeping
+        # out a backoff window — without this an autoscaled node can sit
+        # idle past the drain timeout before the actor it was launched
+        # for even retries (launch/drain churn)
+        self._actor_wake_waiters: List[asyncio.Future] = []
         # dashboard sparkline ring: 2s samples, ~5 minutes of history
         from collections import deque as _deque
 
@@ -502,6 +520,7 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         for pg in self.placement_groups.values():
             pg.opt_wait_used = False
         self._wake_pending_pgs()
+        self._wake_pending_actors()
         if self._chaos_version:
             # late joiners inherit the armed rule set immediately
             asyncio.get_running_loop().call_soon(self._broadcast_chaos)
@@ -652,9 +671,296 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                 "latest_seq": self._pubsub_seq.get(channel, 0)}
 
     async def rpc_drain_node(self, node_id: str):
-        """Graceful removal (reference: node_manager.proto DrainRaylet)."""
+        """Immediate removal (reference: node_manager.proto DrainRaylet).
+        The node is dropped from the tables at once — in-flight work
+        dies and objects are NOT re-replicated.  The autoscaler's
+        scale-down path uses rpc_drain_node_graceful instead; this stays
+        as the forced/operator path."""
         await self._on_node_dead(node_id, "drained")
         return {"ok": True}
+
+    async def rpc_drain_node_graceful(self, node_id: str):
+        """Start the graceful drain state machine for one node
+        (reference: DrainRaylet with a deadline + the autoscaler's
+        drain-before-terminate protocol).  Returns immediately; poll
+        rpc_drain_status.  Idempotent while a drain is in flight.
+
+        Phases (see _drain_task): quiesce (no new leases, warm pools
+        reclaimed) -> migrate_actors (__rt_save__ snapshot + restart
+        elsewhere, no restart budget spent) -> quiesce_leases (in-flight
+        work finishes) -> replicate_objects (sole primary copies pushed
+        to live nodes over the bulk plane and promoted) -> terminate.
+        A drain never proceeds past replicate_objects while a live
+        object's last copy would die with the node."""
+        entry = self.nodes.get(node_id)
+        if entry is None:
+            rec = self._drains.get(node_id)
+            if rec is not None:
+                return {"ok": True, "state": rec["state"]}
+            return {"ok": False, "error": f"unknown node {node_id!r}"}
+        if entry.is_head_node:
+            return {"ok": False, "error": "refusing to drain the head node"}
+        rec = self._drains.get(node_id)
+        if rec is not None and rec["state"] == "draining":
+            return {"ok": True, "state": "draining"}
+        while len(self._drains) >= 32:  # bounded: drop oldest finished
+            done = next((k for k, v in self._drains.items()
+                         if v["state"] != "draining"), None)
+            if done is None:
+                break
+            self._drains.pop(done)
+        rec = self._drains[node_id] = {
+            "node_id": node_id, "state": "draining", "phase": "quiesce",
+            "started_ts": time.time(), "detail": "",
+            "migrated_actors": 0, "replicated_objects": 0,
+            "replicated_bytes": 0,
+        }
+        entry.draining = True
+        self._cluster_version += 1
+        self.mark_dirty()
+        self._broadcast_cluster_view()
+        self.publish("node_events", {"event": "draining",
+                                     "node_id": node_id})
+        asyncio.ensure_future(self._drain_task(entry, rec))
+        return {"ok": True, "state": "draining"}
+
+    async def rpc_drain_status(self, node_id: str):
+        rec = self._drains.get(node_id)
+        if rec is None:
+            return {"state": "none"}
+        return dict(rec)
+
+    async def _drain_task(self, entry: _NodeEntry, rec: Dict[str, Any]):
+        node_id = entry.node_id
+        t0 = time.monotonic()
+        deadline = t0 + float(config.drain_timeout_s)
+        try:
+            client = self._node_client(entry)
+            # 1. the agent stops granting leases, cancels queued
+            # waiters (owners re-route on the drained view) and pushes
+            # an unbounded warm-lease reclaim to every owner
+            await client.call("prepare_drain", timeout=10.0)
+            # 2. restartable actors migrate off: snapshot via
+            # __rt_save__ where supported, restart elsewhere without
+            # spending the restart budget (a drain is not a failure)
+            rec["phase"] = "migrate_actors"
+            await self._drain_migrate_actors(entry, rec)
+            # 3. wait out in-flight task leases — bounded by the grace
+            # budget so one long-running task cannot wedge scale-down
+            rec["phase"] = "quiesce_leases"
+            grace_end = min(deadline,
+                            t0 + float(config.drain_lease_grace_s))
+            while time.monotonic() < grace_end:
+                try:
+                    info = await client.call("drain_info", timeout=10.0)
+                except Exception:
+                    break  # agent gone: node death path takes over
+                if not info.get("leases"):
+                    break
+                await asyncio.sleep(0.2)
+            # 4. no live object's last copy may die with the node
+            rec["phase"] = "replicate_objects"
+            await self._drain_replicate_objects(entry, rec, deadline)
+            # 5. done: drop the node (actors/PGs left on it take the
+            # normal death path — all migratable state is already off)
+            rec["phase"] = "terminate"
+            if node_id in self.nodes:
+                try:
+                    await client.oneway("shutdown_node")
+                except Exception:
+                    pass
+                await self._on_node_dead(node_id, "drained")
+            rec["state"] = "drained"
+            rec["drain_s"] = round(time.monotonic() - t0, 3)
+            from ray_tpu._private.metrics import autoscaler_metrics
+
+            # scale_events_total counts DECISIONS and comes solely from
+            # the autoscaler's report deltas (counting here too would
+            # double every drain); the head owns the duration histogram
+            _g, _events_c, drain_h = autoscaler_metrics()
+            drain_h.observe(time.monotonic() - t0)
+        except Exception as e:
+            # abandon, don't force: the node keeps running with its
+            # data; the autoscaler sees "failed" and may retry later
+            rec["state"] = "failed"
+            rec["detail"] = f"{type(e).__name__}: {e}"[:300]
+            cur = self.nodes.get(node_id)
+            if cur is not None:
+                cur.draining = False
+                self._cluster_version += 1
+                self._broadcast_cluster_view()
+                try:
+                    await self._node_client(cur).call("cancel_drain",
+                                                      timeout=10.0)
+                except Exception:
+                    pass
+
+    async def _drain_migrate_actors(self, entry: _NodeEntry,
+                                    rec: Dict[str, Any]) -> int:
+        """Move every migratable actor off the draining node.
+
+        Migratable = has restart budget left, or persisted state via
+        ``__rt_save__`` just now (a stateful actor with max_restarts=0
+        still resumes with state intact — the drain is planned, not a
+        crash).  Non-migratable actors are exited here too: that is the
+        node's death brought forward, handled by the normal worker-death
+        path (serve replicas get replaced by their controller)."""
+        migrated = 0
+        for actor in list(self.actors.values()):
+            if actor.node_id != entry.node_id or actor.state != ALIVE:
+                continue
+            if actor.addr is None:
+                continue
+            c = RpcClient(actor.addr[0], actor.addr[1], label="drain-actor")
+            saved = False
+            try:
+                try:
+                    r = await c.call("persist_actor_state", timeout=30.0)
+                    saved = bool(r.get("saved"))
+                except Exception:
+                    pass
+                if actor.restarts_left != 0 or saved:
+                    # RESTARTING set BEFORE the worker exits: the
+                    # agent's worker-death report then finds a restart
+                    # already in flight and spends no budget
+                    actor.state = RESTARTING
+                    self.mark_dirty()
+                    self.publish("actor_events", {
+                        "actor_id": actor.actor_id, "state": "RESTARTING",
+                        "name": actor.name,
+                        "cause": f"node {entry.node_id[:8]} draining"})
+                    actor.wake()
+                    migrated += 1
+                try:
+                    await c.oneway("exit_worker")
+                except Exception:
+                    pass
+            finally:
+                await c.close()
+            if actor.state == RESTARTING:
+                self._spawn_scheduler(actor)
+        rec["migrated_actors"] = migrated
+        return migrated
+
+    async def _drain_replicate_objects(self, entry: _NodeEntry,
+                                       rec: Dict[str, Any],
+                                       deadline: float):
+        """Re-replicate every sealed live primary copy the draining node
+        holds whose LAST copy would otherwise die with it.
+
+        The sharded object directory answers "who else holds this" (a
+        secondary copy elsewhere is promoted instead of re-pulled);
+        sole copies are pulled over the PR-4 bulk plane onto the target
+        with the most free arena bytes (PR-9 heartbeat breakdowns are
+        the bin-packing input).  Pulled/promoted copies become PRIMARY
+        (eviction-exempt) and are injected into the directory under the
+        target's node id, so owners whose recorded holder dies resolve
+        the new location through the normal alt-source path."""
+        client = self._node_client(entry)
+        cap = int(config.memory_summary_max_objects)
+        r = await client.call("list_objects", limit=cap, timeout=30.0)
+        listing = r.get("objects", ())
+        if len(listing) >= cap:
+            # a truncated listing could hide a sole primary copy; the
+            # invariant is absolute, so fail the drain safe (the node
+            # returns to service) rather than guess
+            raise RuntimeError(
+                f"object listing truncated at {cap}; refusing to drain "
+                f"a store this large")
+        objs = [o for o in listing
+                if o.get("sealed") and not o.get("freed")
+                and not o.get("channel") and o.get("primary")]
+        if not objs:
+            return
+        targets = [n for n in self.nodes.values()
+                   if n.node_id != entry.node_id and not n.draining]
+        if not targets:
+            raise RuntimeError(
+                f"no live node to take {len(objs)} primary copies")
+        # bin-pack against real free-arena bytes from the heartbeat
+        # byte breakdowns, tracking what this drain already planned in
+        planned: Dict[str, int] = {n.node_id: 0 for n in targets}
+
+        def headroom(n: _NodeEntry) -> float:
+            free = (n.memory or {}).get("arena_free")
+            if free is None:
+                free = config.object_store_memory_bytes
+            return free - planned[n.node_id]
+
+        # one plan per target: an existing directory-recorded secondary
+        # elsewhere picks that node (ensure_local is a no-op when the
+        # copy still exists and re-pulls from the source if it was
+        # evicted meanwhile — the same verified path either way);
+        # everything else bin-packs onto the freest store
+        by_target: Dict[str, List[Tuple[str, int]]] = {}
+        for o in objs:
+            oid, size = o["object_id"], int(o.get("size", 0))
+            others = [nid for nid in self.dir.locations(oid)
+                      if nid != entry.node_id and nid in self.nodes
+                      and not self.nodes[nid].draining]
+            if others:
+                by_target.setdefault(others[0], []).append((oid, size))
+                continue
+            target = max(targets, key=headroom)
+            planned[target.node_id] += size
+            by_target.setdefault(target.node_id, []).append((oid, size))
+        moved = moved_bytes = 0
+
+        async def source_still_holds(oid: str) -> bool:
+            # the owner may free an object mid-drain — only a copy the
+            # source STILL holds blocks the hand-off
+            try:
+                return bool(await client.call("store_contains", oid=oid,
+                                              timeout=10.0))
+            except Exception:
+                return True  # unknown: assume it blocks (fail safe)
+
+        for nid, items in by_target.items():
+            node = self.nodes.get(nid)
+            if node is None:
+                raise RuntimeError(f"target {nid[:12]} died mid-drain")
+            tclient = self._node_client(node)
+            budget = max(5.0, deadline - time.monotonic())
+            res = await tclient.call(
+                "ensure_local_batch",
+                items=[[oid, [entry.host, entry.port]]
+                       for oid, _sz in items],
+                timeout=budget)
+            held: List[Tuple[str, int]] = []
+            for (oid, size), item_res in zip(items,
+                                             res.get("results") or ()):
+                if item_res.get("ok"):
+                    held.append((oid, size))
+                elif await source_still_holds(oid):
+                    raise RuntimeError(
+                        f"sole primary copy {oid[:12]} could not be "
+                        f"re-replicated: {item_res.get('error')}")
+            if not held:
+                continue
+            reply = await tclient.call(
+                "store_promote", oids=[oid for oid, _sz in held],
+                timeout=30.0)
+            missing = set(reply.get("missing") or ())
+            for oid in missing:
+                # vanished between the pull and the promote: legal only
+                # if the object was freed everywhere — a copy the source
+                # still holds means the hand-off failed
+                if await source_still_holds(oid):
+                    raise RuntimeError(
+                        f"target {nid[:12]} lost copy {oid[:12]} before "
+                        f"promote; drain aborted")
+            handed = [(oid, sz) for oid, sz in held if oid not in missing]
+            if not handed:
+                continue
+            # findable by every puller: small objects never ride the
+            # heartbeat summaries, so the head injects the new holder
+            # into the directory itself
+            self.dir.apply_delta(nid, [[oid, sz] for oid, sz in handed],
+                                 ())
+            moved += len(handed)
+            moved_bytes += sum(sz for _oid, sz in handed)
+        rec["replicated_objects"] = moved
+        rec["replicated_bytes"] = moved_bytes
 
     # ---- chaos fault injection ---------------------------------------------
 
@@ -720,10 +1026,13 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
 
     def _cluster_view(self) -> Dict[str, Any]:
         """Per-node resources/labels.  Object locations ride the sharded
-        directory's versioned shard updates, not this view."""
+        directory's versioned shard updates, not this view.  Draining
+        nodes are flagged so agent-side routing (spillback, pick_node)
+        stops targeting them within one view push."""
         return {nid: {"addr": [n.host, n.port],
                       "res": n.resources.to_dict(),
-                      "labels": n.labels, "xfer": n.xfer_port}
+                      "labels": n.labels, "xfer": n.xfer_port,
+                      **({"draining": True} if n.draining else {})}
                 for nid, n in self.nodes.items()}
 
     def on_peer_disconnect(self, conn) -> None:
@@ -1025,7 +1334,10 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                     continue
                 nid = pg.placements[max(ts.bundle_index, 0)]
             else:
-                cluster = {nid: n.resources for nid, n in self.nodes.items()}
+                # draining nodes accept no new actors — their leases are
+                # being quiesced and the node is about to terminate
+                cluster = {nid: n.resources for nid, n in self.nodes.items()
+                           if not n.draining}
                 nid = pick_node(
                     cluster, demand, local_node_id="",
                     strategy=ts.scheduling_strategy,
@@ -1045,7 +1357,9 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                     # scale-up can never mint the specific node they name,
                     # so they burn the budget and die.
                     attempt -= 1
-                await asyncio.sleep(delay)
+                # woken early by a node registration (an autoscaled
+                # node arriving for exactly this demand), else backoff
+                await self._wait_actor_event(delay)
                 delay = min(delay * 2, 2.0)
                 continue
             node = self.nodes.get(nid)
@@ -1287,7 +1601,7 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                   else NodeResources.from_dict(
                       {"total": n.resources.total.to_dict(),
                        "available": n.resources.available.to_dict()}))
-            for nid, n in self.nodes.items()
+            for nid, n in self.nodes.items() if not n.draining
         }
         if optimistic:
             for pg in self.placement_groups.values():
@@ -1338,6 +1652,26 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         for fut in waiters:
             if not fut.done():
                 fut.set_result(True)
+
+    def _wake_pending_actors(self) -> None:
+        """Fresh capacity registered: parked actor schedulers retry now."""
+        if not self._actor_wake_waiters:
+            return
+        waiters, self._actor_wake_waiters = self._actor_wake_waiters, []
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result(True)
+
+    async def _wait_actor_event(self, timeout: float) -> None:
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._actor_wake_waiters.append(fut)
+        try:
+            await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            if fut in self._actor_wake_waiters:
+                self._actor_wake_waiters.remove(fut)
 
     async def _wait_pg_event(self, timeout: float) -> bool:
         """Wait for a resource-release wake, or timeout. True if woken."""
@@ -1543,8 +1877,19 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
             boundaries=[0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1,
                         5, 30])
 
+        from ray_tpu._private.metrics import autoscaler_metrics
+
+        as_nodes_g, _as_events, _as_drain = autoscaler_metrics()
+
         def collect():
             nodes_g.set(len(self.nodes))
+            draining = sum(1 for n in self.nodes.values() if n.draining)
+            as_nodes_g.set(len(self.nodes) - draining,
+                           tags={"state": "running"})
+            as_nodes_g.set(draining, tags={"state": "draining"})
+            as_nodes_g.set(
+                float(self._autoscaler_status.get("pending_launches", 0)),
+                tags={"state": "pending_launch"})
             # seed every state with 0 so a series whose count drops to
             # zero reports 0 instead of its stale last value
             states = {s: 0 for s in (PENDING, ALIVE, RESTARTING, DEAD)}
@@ -1601,6 +1946,7 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                         "/api/profile": profile_route,
                         "/api/memory": memory_route,
                         "/api/summary": self._render_summary_json,
+                        "/api/autoscaler": self._render_autoscaler_json,
                     })
             self._dash_task = asyncio.ensure_future(self._dash_sample_loop())
         except Exception:
@@ -1695,6 +2041,7 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
             "jobs": jobs,
             "traces": self._trace_summaries(50),
             "series": list(self._dash_series),
+            "autoscaler": self._autoscaler_view(),
             "summary": {
                 "cpus_avail": round(avail, 2), "cpus_total": round(total, 2),
                 "actors_alive": sum(1 for a in self.actors.values()
@@ -2455,12 +2802,12 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         autoscaler re-registers every pass, so a restarted head relearns
         the types within one update period."""
         if dict(node_types) == self._autoscaler_types:
-            return {"ok": True}
+            return {"ok": True, "epoch": self.dir.epoch}
         self._autoscaler_types = dict(node_types)
         self._cluster_version += 1
         self.mark_dirty()
         self._broadcast_cluster_view()
-        return {"ok": True}
+        return {"ok": True, "epoch": self.dir.epoch}
 
     async def rpc_autoscaler_state(self):
         """Aggregate demand + supply snapshot for the autoscaler loop
@@ -2487,11 +2834,117 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                  "total": n.resources.total.to_dict(),
                  "available": n.resources.available.to_dict(),
                  "pending": n.pending_demands,
+                 "draining": n.draining,
                  "heartbeat_age_s": time.monotonic() - n.last_heartbeat}
                 for n in self.nodes.values()],
             "pending_pg_bundles": pending_pg_bundles,
             "pending_actors": pending_actors,
         }
+
+    def _sched_queued_p99_ms(self, sample: int = 500) -> float:
+        """Queued-phase (submitted->leased) p99 over the most recent
+        task events — the autoscaler's scheduler-latency SLO signal."""
+        recs = list(self.task_events.values())[-sample:]
+        waits = []
+        for rec in recs:
+            sub, leased = rec.get("submitted_ts"), rec.get("leased_ts")
+            if sub is not None and leased is not None:
+                waits.append(max(0.0, leased - sub))
+        if not waits:
+            return 0.0
+        waits.sort()
+        return round(
+            waits[min(len(waits) - 1, int(len(waits) * 0.99))] * 1000, 3)
+
+    def _ts_tail(self, metric: str, k: int = 10) -> Dict[str, List[float]]:
+        """Last k ring samples of one heartbeat metric per node — the
+        autoscaler's trend-smoothing input (PR-6 time-series ring)."""
+        out: Dict[str, List[float]] = {}
+        for (node, name), dq in self._tseries.items():
+            if name == metric and dq:
+                out[node] = [v for _ts, v in list(dq)[-k:]]
+        return out
+
+    async def rpc_autoscaler_snapshot(self):
+        """The v2 autoscaler input: the v1 demand/supply state plus the
+        signals prior subsystems built — lease-queue-depth trends from
+        the PR-6 time-series ring (hysteresis input), scheduler-latency
+        p99 from the task-event store (SLO pressure), per-node store
+        byte breakdowns from PR-9 memory accounting (drain-victim
+        bin-packing), Serve/LLM queue pressure from the heartbeat gauge
+        summaries, and live drain records.  ``epoch`` is the head's
+        boot token: a change tells the autoscaler to re-register its
+        node types (the DeltaReporter epoch-handshake pattern)."""
+        snap = await self.rpc_autoscaler_state()
+        by_id = {n.node_id: n for n in self.nodes.values()}
+        for n_out in snap["nodes"]:
+            n = by_id.get(n_out["node_id"])
+            if n is not None:
+                mem = n.memory or {}
+                n_out["memory"] = {
+                    "arena_used": mem.get("arena_used", 0),
+                    "arena_free": mem.get("arena_free", 0),
+                    "num_objects": mem.get("num_objects", 0),
+                }
+        snap["epoch"] = self.dir.epoch
+        snap["signals"] = {
+            "lease_queue_depth": self._ts_tail("lease_queue_depth"),
+            "sched_queued_p99_ms": self._sched_queued_p99_ms(),
+            "serve": {
+                "llm_queue_depth": self._ts_tail("llm_queue_depth", k=5),
+                "llm_tokens_per_step": self._ts_tail("llm_tokens_per_step",
+                                                     k=5),
+            },
+        }
+        snap["drains"] = {nid: dict(rec)
+                          for nid, rec in self._drains.items()}
+        return snap
+
+    async def rpc_autoscaler_report(self, status: Optional[Dict[str, Any]]
+                                    = None):
+        """The autoscaler's per-pass status push: pending launches,
+        nodes it is draining, the last decision and why — stored for
+        /api/autoscaler and `rtpu status`, with scale-event deltas
+        folded into ray_tpu_autoscaler_scale_events_total."""
+        st = dict(status or {})
+        st["ts"] = time.time()
+        deltas = st.pop("events_delta", None) or {}
+        try:
+            from ray_tpu._private.metrics import autoscaler_metrics
+
+            _g, events_c, _h = autoscaler_metrics()
+            for kind in ("up", "down"):
+                n = int(deltas.get(kind, 0))
+                if n > 0:
+                    events_c.inc(n, tags={"kind": kind})
+        except Exception:
+            pass
+        self._autoscaler_status = st
+        return {"ok": True, "epoch": self.dir.epoch}
+
+    def _autoscaler_view(self) -> Dict[str, Any]:
+        """Shared payload behind rpc_autoscaler_status, /api/autoscaler
+        and the `rtpu status` pane — the debuggability surface for
+        scale events."""
+        return {
+            "report": dict(self._autoscaler_status),
+            "registered_types": {k: dict(v) for k, v
+                                 in self._autoscaler_types.items()},
+            "draining": [n.node_id for n in self.nodes.values()
+                         if n.draining],
+            "drains": {nid: dict(rec)
+                       for nid, rec in self._drains.items()},
+            "ts": time.time(),
+        }
+
+    async def rpc_autoscaler_status(self):
+        return self._autoscaler_view()
+
+    def _render_autoscaler_json(self):
+        import json as _json
+
+        return "application/json", _json.dumps(
+            self._autoscaler_view(), default=str).encode()
 
     # ---- misc --------------------------------------------------------------
 

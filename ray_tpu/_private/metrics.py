@@ -552,6 +552,52 @@ def object_store_breakdown_gauge() -> Gauge:
     return _store_breakdown_gauge
 
 
+_autoscaler_metrics = None
+
+
+def autoscaler_metrics() -> Tuple[Gauge, Counter, Histogram]:
+    """Process-singleton autoscaler families (head-side; see
+    _private/head.py drain state machine + autoscaler/autoscaler.py):
+    ``ray_tpu_autoscaler_nodes`` — node counts by
+    state=running|draining|pending_launch (pending_launch comes from the
+    autoscaler's status report, the rest from the head node table);
+    ``ray_tpu_autoscaler_scale_events_total`` — scale decisions acted
+    on, labeled kind=up|down; ``ray_tpu_autoscaler_drain_seconds`` —
+    wall time of each graceful drain (lease quiesce + actor migration +
+    object re-replication), the latency cost of a scale-down."""
+    global _autoscaler_metrics
+    if _autoscaler_metrics is None:
+        _autoscaler_metrics = (
+            Gauge("ray_tpu_autoscaler_nodes",
+                  "autoscaler node view by state "
+                  "(running|draining|pending_launch)"),
+            Counter("ray_tpu_autoscaler_scale_events_total",
+                    "autoscaler scale decisions acted on, by kind=up|down"),
+            Histogram("ray_tpu_autoscaler_drain_seconds",
+                      "graceful node drain duration",
+                      boundaries=[0.1, 0.5, 1, 2, 5, 10, 30, 60, 120]),
+        )
+    return _autoscaler_metrics
+
+
+_serve_sheds_counter: Optional[Counter] = None
+
+
+def serve_sheds_counter() -> Counter:
+    """Process-singleton ``ray_tpu_serve_sheds_total``: requests turned
+    away with 503, labeled reason=proxy (the proxy-wide inflight gate)
+    or reason=replica (replica-side admission shed, e.g. an LLM
+    engine's full admission queue).  A rising rate is the serve
+    autoscaler's SLO-pressure signal — replicas (and, transitively,
+    nodes) should be scaling up while this climbs."""
+    global _serve_sheds_counter
+    if _serve_sheds_counter is None:
+        _serve_sheds_counter = Counter(
+            "ray_tpu_serve_sheds_total",
+            "serve requests shed with 503, by reason=proxy|replica")
+    return _serve_sheds_counter
+
+
 _serve_request_latency: Optional[Histogram] = None
 
 

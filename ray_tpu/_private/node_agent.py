@@ -218,6 +218,10 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         self._last_loop_lag = 0.0
         # chaos gossip state: last rule-set version applied from the head
         self._seen_chaos_version = 0
+        # graceful scale-down: while draining this agent grants no new
+        # leases (owners re-route on the head's drained cluster view),
+        # advertises no pending demand, and has its warm leases reclaimed
+        self._draining = False
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -524,6 +528,9 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
     def _pending_for_heartbeat(self) -> List[Dict[str, float]]:
         """Queued lease demands plus parked infeasible-but-scalable
         demands (the autoscaler's input; reference: load_metrics.py)."""
+        if self._draining:
+            # a draining node's backlog must not read as scale-up demand
+            return []
         now = time.monotonic()
         self._infeasible = {k: v for k, v in self._infeasible.items()
                             if v[1] > now}
@@ -651,6 +658,64 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
 
     async def rpc_store_usage(self):
         return self.store.usage()
+
+    async def rpc_store_promote(self, oids: List[str]):
+        """Drain hand-off: copies this node pulled become PRIMARY so
+        eviction can't discard them once the original holder is gone.
+        ``missing`` names oids with no sealed local copy — the caller
+        must not count those as handed off."""
+        promoted, missing = self.store.promote(list(oids or ()))
+        return {"promoted": promoted, "missing": missing}
+
+    # ---- graceful drain participation (head drain state machine) -----------
+
+    async def rpc_prepare_drain(self):
+        """Enter drain mode: refuse new leases, cancel queued lease
+        waiters so their owners re-route (the head's drained view no
+        longer targets us), and push an UNBOUNDED warm-lease reclaim
+        (need={}) to every lease owner — the whole warm pool on this
+        node returns instead of waiting out its TTL."""
+        self._draining = True
+        # queued waiters: wake with "canceled" — the owner's pump
+        # retries the demand and the fresh view routes it elsewhere
+        for token in list(self._lease_waiters):
+            entry = self._lease_waiters.pop(token, None)
+            if entry is None:
+                continue
+            fut, _demand, sched = entry
+            _found, granted = sched.cancel(token)
+            for tok in granted:
+                self._grant_token(tok)
+            if not fut.done():
+                fut.set_result("canceled")
+        payload = {"agent": [self.host, self.port], "need": {}}
+        conns = {id(l.owner_conn): l.owner_conn
+                 for l in self._leases.values()
+                 if l.owner_conn is not None}
+
+        async def _push(conn):
+            try:
+                await conn.push("reclaim_idle_leases", payload)
+            except Exception:
+                pass
+
+        for conn in conns.values():
+            asyncio.ensure_future(_push(conn))
+        self._hb_wake.set()
+        return {"ok": True, "leases": len(self._leases)}
+
+    async def rpc_cancel_drain(self):
+        """Drain abandoned (head-side failure/timeout): resume granting."""
+        self._draining = False
+        return {"ok": True}
+
+    async def rpc_drain_info(self):
+        """Drain progress the head polls: remaining leases are the
+        quiesce gate (idle pooled workers don't block a drain)."""
+        return {"draining": self._draining,
+                "leases": len(self._leases),
+                "workers": len(self._workers),
+                "queued": len(self._lease_waiters)}
 
     # ---- compiled-DAG channels (see dag/channel.py) ------------------------
     # A channel slot is a reusable pinned shm allocation: the writer-node
@@ -1288,6 +1353,11 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         """
         ts = TaskSpec.from_wire(spec)
         demand = ts.resource_set()
+        if self._draining:
+            # owners treat this as a retriable lease timeout; by their
+            # next ask the drained cluster view routes them elsewhere
+            await asyncio.sleep(0.2)  # pace retries against a drainer
+            return {"error": "lease timeout", "error_str": "node draining"}
         if not grant_only:
             self._rebind_owner_leases(ts.caller_id, _conn)
         chaos = fault_injection.decide("lease.grant",
@@ -1323,9 +1393,12 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
             nid: NodeResources.from_dict(
                 {"total": v["res"]["total"], "available": v["res"]["available"]})
             for nid, v in self.cluster_view.items()
+            # draining nodes accept no new work — never spill back there
+            if not v.get("draining")
         }
         # our own view is fresher than the gossiped one
-        cluster[self.node_id] = self.resources
+        if not self._draining:
+            cluster[self.node_id] = self.resources
         labels = {nid: v.get("labels", {})
                   for nid, v in self.cluster_view.items()}
         labels[self.node_id] = self.labels
@@ -1376,6 +1449,9 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         post-reply pump re-asks for the rest."""
         ts = TaskSpec.from_wire(spec)
         demand = ts.resource_set()
+        if self._draining:
+            await asyncio.sleep(0.2)
+            return {"error": "lease timeout", "error_str": "node draining"}
         self._rebind_owner_leases(ts.caller_id, _conn)
         chaos = fault_injection.decide("lease.grant",
                                        key=ts.actor_id or ts.function_id)
@@ -2036,6 +2112,7 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
             "num_workers": len(self._workers),
             "num_idle": len(self._idle),
             "num_leases": len(self._leases),
+            "draining": self._draining,
             "store": self.store.usage(),
             "xfer_port": self.xfer_port,
             "xfer_stats": dict(self.xfer_stats),

@@ -314,6 +314,25 @@ class StoreCore:
         if entry is not None and not entry.sealed:
             self._drop(oid, entry)
 
+    def promote(self, oids: List[str]) -> Tuple[int, List[str]]:
+        """Mark sealed copies PRIMARY (eviction-exempt).  The drain
+        protocol hands primary-ship to the node that took over a
+        draining node's copies — a secondary copy could be evicted
+        under pressure the moment the original holder terminates.
+        Returns (newly promoted, MISSING oids) — missing means this
+        store holds no sealed copy (evicted/freed since the caller
+        looked), which the drain must treat as not-handed-off."""
+        n = 0
+        missing: List[str] = []
+        for oid in oids:
+            entry = self.objects.get(oid)
+            if entry is None or not entry.sealed:
+                missing.append(oid)
+            elif not entry.primary:
+                entry.primary = True
+                n += 1
+        return n, missing
+
     async def get(self, oids: List[str], client_id: str,
                   wait_timeout: Optional[float] = None) -> List[Optional[Dict[str, Any]]]:
         """Wait for each oid to be sealed locally; pin and return locations.

@@ -1021,7 +1021,20 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             c = await self._aclient_agent(node)
             await c.call("store_free", oids=[oid])
         except Exception:
-            pass
+            # recorded holder unreachable — the copy may have migrated
+            # off a drained node; free wherever the head's directory
+            # says it lives now, so a scale-down can't strand bytes
+            try:
+                r = await self.head.aio.call("object_locations",
+                                             oids=[oid])
+                for host, port in r.get("locations", {}).get(oid, []):
+                    try:
+                        c = await self._aclient_agent((host, port))
+                        await c.call("store_free", oids=[oid])
+                    except Exception:
+                        pass
+            except Exception:
+                pass
 
     # ---- borrower/owner RPCs ----
 
@@ -3174,6 +3187,18 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
 
     async def rpc_exit_worker(self):
         self._task_queue.put(None)
+
+    async def rpc_persist_actor_state(self):
+        """Drain hook: flush this worker's actor state via ``__rt_save__``
+        right now (the head calls it before migrating the actor off a
+        draining node).  {"saved": False} when the actor has no save
+        hook or no durable storage is configured — the head then falls
+        back to a plain (stateless) restart or a normal death."""
+        import asyncio as _aio
+
+        saved = await _aio.get_running_loop().run_in_executor(
+            None, self.persist_actor_state)
+        return {"saved": bool(saved)}
 
     def _finish_exec(self, task_id: str) -> None:
         self._cancelled_exec.discard(task_id)

@@ -130,7 +130,9 @@ class AutoscalingCluster:
     def __init__(self, head_resources: Optional[Dict[str, float]] = None,
                  worker_node_types: Optional[Dict[str, Any]] = None,
                  idle_timeout_s: float = 60.0,
-                 update_period_s: float = 0.5):
+                 update_period_s: float = 0.5,
+                 upscale_consecutive: Optional[int] = None,
+                 sched_p99_threshold_ms: Optional[float] = None):
         from ray_tpu.autoscaler import (AutoscalerConfig,
                                         FakeMultiNodeProvider,
                                         StandardAutoscaler)
@@ -146,12 +148,19 @@ class AutoscalingCluster:
             self.cluster.head_addr, self.provider,
             AutoscalerConfig(worker_node_types or {},
                              idle_timeout_s=idle_timeout_s,
-                             update_period_s=update_period_s))
+                             update_period_s=update_period_s,
+                             upscale_consecutive=upscale_consecutive,
+                             sched_p99_threshold_ms=sched_p99_threshold_ms))
         self.autoscaler.start()
 
     @property
     def address(self) -> str:
         return self.cluster.address
+
+    def status(self) -> Dict[str, Any]:
+        """The autoscaler's live status (pending launches, draining
+        nodes, last decision) — what /api/autoscaler serves."""
+        return self.autoscaler.status()
 
     def shutdown(self) -> None:
         self.autoscaler.stop()

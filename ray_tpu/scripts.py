@@ -140,6 +140,46 @@ def _print_status(addr, head) -> None:
         print(f"pending demands: {pending} lease(s), "
               f"{len(auto['pending_pg_bundles'])} pg bundle(s), "
               f"{len(auto['pending_actors'])} actor(s)")
+    _print_autoscaler(head)
+
+
+def _print_autoscaler(head) -> None:
+    """Autoscaler pane: pending launches, draining nodes, the last
+    decision and live/finished drain records (also at /api/autoscaler)
+    — the debuggability surface for scale events."""
+    try:
+        st = head.call("autoscaler_status", timeout=10)
+    except Exception:
+        return
+    report = st.get("report") or {}
+    draining = st.get("draining") or []
+    drains = st.get("drains") or {}
+    if not report and not draining and not drains \
+            and not st.get("registered_types"):
+        return  # no autoscaler attached: keep status terse
+    print("autoscaler:")
+    if st.get("registered_types"):
+        types = ", ".join(sorted(st["registered_types"]))
+        print(f"  node types: {types}")
+    if report:
+        print(f"  pending launches: {report.get('pending_launches', 0)}  "
+              f"scale events: up={report.get('scale_up_total', 0)} "
+              f"down={report.get('scale_down_total', 0)}")
+        if report.get("last_decision"):
+            print(f"  last decision: {report['last_decision']}")
+    if draining:
+        print(f"  draining now: {', '.join(n[:12] for n in draining)}")
+    for nid, rec in list(drains.items())[-4:]:
+        extra = ""
+        if rec.get("state") == "drained":
+            extra = (f" in {rec.get('drain_s', 0)}s, "
+                     f"{rec.get('migrated_actors', 0)} actor(s) migrated, "
+                     f"{rec.get('replicated_objects', 0)} object(s) "
+                     f"re-replicated")
+        elif rec.get("detail"):
+            extra = f": {rec['detail']}"
+        print(f"  drain {nid[:12]}: {rec.get('state')}"
+              f"/{rec.get('phase', '')}{extra}")
 
 
 def _print_timeseries(head) -> None:

@@ -168,7 +168,10 @@ class _BatchState:
 class Deployment:
     func_or_class: Any
     name: str
-    num_replicas: int = 1
+    # int, or "auto" — replica count then follows load between the
+    # autoscaling_config's min/max bounds (reference: serve's
+    # num_replicas="auto" + autoscaling_config)
+    num_replicas: Any = 1
     max_ongoing_requests: int = 8
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     init_args: tuple = ()
@@ -207,7 +210,7 @@ class Application:
 
 
 def deployment(_cls: Any = None, *, name: Optional[str] = None,
-               num_replicas: int = 1, max_ongoing_requests: int = 8,
+               num_replicas: Any = 1, max_ongoing_requests: int = 8,
                ray_actor_options: Optional[Dict[str, Any]] = None,
                autoscaling_config: Optional[Dict[str, Any]] = None):
     def make(target):
@@ -582,8 +585,14 @@ class ServeController:
                                 app.get("loop_refs", {}).pop(
                                     r._actor_id, None)
                             self._ensure_llm_loop(app, r)
+                        # engine backlog feeds the replica autoscaler:
+                        # queued sequences mean token-boundary admission
+                        # is falling behind this replica's decode loop
+                        app.setdefault("replica_queue", {})[
+                            r._actor_id] = int(st.get("queued", 0))
                     except ray_tpu.RayError:
-                        pass  # health pass below handles dead replicas
+                        app.setdefault("replica_queue", {}).pop(
+                            r._actor_id, None)
         # 1. health: drop replicas that fail a health probe.  Definitive
         # death (ActorDied/worker gone) drops immediately; a TIMEOUT
         # alone needs consecutive misses — a replica paying a long jit
@@ -615,21 +624,9 @@ class ServeController:
                 ray_tpu.kill(r)
             except Exception:
                 pass
-        # 2. autoscaling: follow reported ongoing requests
-        desired = app["desired"]
-        auto = app.get("autoscaling")
-        if auto:
-            now = time.monotonic()
-            with self._lock:
-                reports = list(app["ongoing"].values())
-            total = sum(c for c, ts in reports if now - ts < 5.0)
-            target = max(1, int(auto.get("target_ongoing_requests", 2)))
-            import math
-
-            desired = min(int(auto.get("max_replicas", 8)),
-                          max(int(auto.get("min_replicas", 1)),
-                              math.ceil(total / target)))
-            app["desired"] = desired
+        # 2. autoscaling: replica count follows load signals with
+        # hysteresis (see _autoscale_desired)
+        desired = self._autoscale_desired(app, len(alive))
         # 3. converge replica count; scale-down victims drain first (they
         # leave the routing table now, die a few seconds later so
         # in-flight requests finish)
@@ -667,6 +664,8 @@ class ServeController:
         with self._lock:
             app["ongoing"] = {h: (c, ts) for h, (c, ts) in
                               app["ongoing"].items() if now - ts < 10.0}
+            app["sheds"] = {h: (c, ts) for h, (c, ts) in
+                            app.get("sheds", {}).items() if now - ts < 10.0}
         if changed:
             with self._lock:
                 current = self.apps.get(name) is app
@@ -680,6 +679,10 @@ class ServeController:
                     app["loop_refs"] = {
                         aid: ref for aid, ref in
                         app.get("loop_refs", {}).items() if aid in live_ids}
+                    app["replica_queue"] = {
+                        aid: q for aid, q in
+                        app.get("replica_queue", {}).items()
+                        if aid in live_ids}
                     app["health_misses"] = {
                         aid: n for aid, n in
                         app.get("health_misses", {}).items()
@@ -696,6 +699,87 @@ class ServeController:
                         pass
             else:
                 self._save_checkpoint()
+
+    def _autoscale_desired(self, app: Dict[str, Any],
+                           alive_count: int) -> int:
+        """One autoscaling decision for one deployment.
+
+        Signals (reference: autoscaling_policy.py, extended for the LLM
+        tier): windowed handle-reported ongoing requests, replica-side
+        engine queue depth (the stats probe above — sequences parked at
+        token-boundary admission), and handle-reported 503 sheds (a
+        shed means capacity is short RIGHT NOW: desired jumps past the
+        current count instead of waiting for averages to catch up).
+
+        Hysteresis: an upscale needs the computed desired above the
+        current one for ``serve_autoscale_up_consecutive`` consecutive
+        reconcile rounds; a downscale needs it below for
+        ``serve_autoscale_down_delay_s`` — one burst neither thrashes
+        replicas up nor tears warm replicas down the moment it ends."""
+        auto = app.get("autoscaling")
+        if not auto:
+            return app["desired"]
+        import math
+
+        from ray_tpu._private.config import config as _cfg
+
+        now = time.monotonic()
+        with self._lock:
+            reports = list(app["ongoing"].values())
+            shed_reports = list(app.get("sheds", {}).values())
+        total = sum(c for c, ts in reports if now - ts < 5.0)
+        recent_sheds = sum(c for c, ts in shed_reports if now - ts < 5.0)
+        queued = sum(app.get("replica_queue", {}).values())
+        target = max(1, int(auto.get(
+            "target_ongoing_requests",
+            _cfg.serve_autoscale_target_ongoing)))
+        want = math.ceil((total + queued) / target)
+        if recent_sheds:
+            want = max(want, alive_count + 1)
+        lo = int(auto.get("min_replicas",
+                          _cfg.serve_autoscale_min_replicas))
+        hi = int(auto.get("max_replicas",
+                          _cfg.serve_autoscale_max_replicas))
+        want = min(hi, max(lo, want))
+        cur = app["desired"]
+        up_needed = max(1, int(auto.get(
+            "upscale_consecutive", _cfg.serve_autoscale_up_consecutive)))
+        down_delay = float(auto.get("downscale_delay_s",
+                                    _cfg.serve_autoscale_down_delay_s))
+        if want > cur:
+            app["up_streak"] = app.get("up_streak", 0) + 1
+            app["below_since"] = None
+            if app["up_streak"] >= up_needed:
+                app["desired"] = want
+                app["up_streak"] = 0
+        elif want < cur:
+            app["up_streak"] = 0
+            t0 = app.get("below_since")
+            if t0 is None:
+                app["below_since"] = now
+            elif now - t0 >= down_delay:
+                app["desired"] = want
+                app["below_since"] = None
+        else:
+            app["up_streak"] = 0
+            app["below_since"] = None
+        app["last_autoscale"] = {
+            "want": want, "ongoing": total, "queued": queued,
+            "sheds": recent_sheds, "desired": app["desired"]}
+        return app["desired"]
+
+    def autoscale_status(self, name: str):
+        """Debuggability: the last autoscale inputs/decision for one
+        deployment (surfaced by tests and `rtpu status`-adjacent
+        tooling)."""
+        with self._lock:
+            app = self.apps.get(name)
+            if app is None:
+                return None
+            return {"desired": app["desired"],
+                    "replicas": len(app["replicas"]),
+                    "autoscaling": dict(app.get("autoscaling") or {}),
+                    "last": dict(app.get("last_autoscale") or {})}
 
     # ---- handle-facing RPCs ------------------------------------------------
 
@@ -734,11 +818,15 @@ class ServeController:
                     r._actor_id: node_of.get(r._actor_id, "")
                     for r in app["replicas"]}
 
-    def report_metrics(self, name: str, handle_id: str, ongoing: int):
+    def report_metrics(self, name: str, handle_id: str, ongoing: int,
+                       sheds: int = 0):
         with self._lock:
             app = self.apps.get(name)
             if app is not None:
-                app["ongoing"][handle_id] = (ongoing, time.monotonic())
+                now = time.monotonic()
+                app["ongoing"][handle_id] = (ongoing, now)
+                if sheds:
+                    app.setdefault("sheds", {})[handle_id] = (sheds, now)
         return True
 
     def delete(self, name: str):
@@ -970,8 +1058,11 @@ class _MetricsPusher:
         if now - h._last_push < self.PUSH_PERIOD_S:
             return
         h._last_push = now
+        with h._lock:
+            sheds, h._sheds_pending = h._sheds_pending, 0
         ctrl = _controller()
-        ctrl.report_metrics.remote(h._name, h._handle_id, int(round(avg)))
+        ctrl.report_metrics.remote(h._name, h._handle_id, int(round(avg)),
+                                   sheds)
 
 
 _metrics_pusher = _MetricsPusher()
@@ -1003,7 +1094,15 @@ class DeploymentHandle:
         self._last_refresh = time.monotonic()
         self._samples: List[int] = []  # recent inflight samples (window)
         self._last_push = 0.0
+        # 503s observed against this deployment (proxy gate or replica
+        # admission), drained to the controller with each metrics push —
+        # the replica autoscaler's immediate scale-up trigger
+        self._sheds_pending = 0
         _metrics_pusher.register(self)
+
+    def note_shed(self) -> None:
+        with self._lock:
+            self._sheds_pending += 1
 
     def _set_replicas(self, replica_ids: List[str],
                       replica_nodes: Optional[List[str]] = None):
@@ -1347,12 +1446,27 @@ def run(app: Application, name: Optional[str] = None) -> DeploymentHandle:
 
     d = app.deployment
     dep_name = name or d.name
+    num_replicas = d.num_replicas
+    autoscaling = d.autoscaling_config
+    if num_replicas == "auto":
+        # declarative elasticity: replica count follows load between
+        # the config bounds (the controller's reconcile loop scales on
+        # ongoing requests + replica queue depth + shed pressure)
+        autoscaling = dict(autoscaling or {})
+        autoscaling.setdefault("min_replicas",
+                               int(config.serve_autoscale_min_replicas))
+        autoscaling.setdefault("max_replicas",
+                               int(config.serve_autoscale_max_replicas))
+        autoscaling.setdefault(
+            "target_ongoing_requests",
+            int(config.serve_autoscale_target_ongoing))
+        num_replicas = int(autoscaling["min_replicas"])
     ctrl = _controller()
     try:
         ray_tpu.get(ctrl.deploy.remote(
-            dep_name, cloudpickle.dumps(d.func_or_class), d.num_replicas,
+            dep_name, cloudpickle.dumps(d.func_or_class), num_replicas,
             d.max_ongoing_requests, d.init_args, d.init_kwargs,
-            d.ray_actor_options, d.autoscaling_config,
+            d.ray_actor_options, autoscaling,
             float(config.serve_replica_health_timeout_s), d.llm),
             timeout=float(config.serve_replica_health_timeout_s) + 120.0)
     except ray_tpu.RayTaskError as e:

@@ -110,7 +110,8 @@ class _HttpProxy:
         from ray_tpu._private.config import config
         from ray_tpu._private.metrics import (default_registry,
                                               serve_proxy_inflight_gauge,
-                                              serve_request_latency_histogram)
+                                              serve_request_latency_histogram,
+                                              serve_sheds_counter)
 
         self._handles: Dict[str, Any] = {}
         self._legacy = legacy_threads
@@ -119,6 +120,8 @@ class _HttpProxy:
             else config.serve_max_inflight_requests)
         self._inflight = 0  # loop-confined: touched only on the proxy loop
         self._latency = serve_request_latency_histogram()
+        # 503s by reason — the serve autoscaler's SLO-pressure signal
+        self._sheds = serve_sheds_counter()
         # inflight gauge sampled at metrics render — zero cost on the
         # request hot path (see metrics.serve_proxy_inflight_gauge).
         # The collector is deregistered when the serve loop exits so a
@@ -415,6 +418,8 @@ class _HttpProxy:
             return await self._route_inner(method, target, headers, body)
         if not self._legacy and self._inflight >= self._max_inflight:
             self._latency.observe(0.0, tags={"code": "503"})
+            self._sheds.inc(tags={"reason": "proxy"})
+            self._note_shed(path.strip("/"))
             return ("503 Service Unavailable",
                     b'{"error": "proxy overloaded, try again"}', None)
         self._inflight += 1
@@ -447,6 +452,17 @@ class _HttpProxy:
             span.set_attribute("http.status", status.split(" ", 1)[0])
             span.end(error="" if status.startswith("2") else status)
         return status, payload, stream
+
+    def _note_shed(self, name: str) -> None:
+        """Report a shed against the deployment's handle so the
+        metrics pusher carries it to the controller — the replica
+        autoscaler's scale-up trigger (declared headroom (c))."""
+        handle = self._handles.get(name)
+        if handle is not None:
+            try:
+                handle.note_shed()
+            except Exception:
+                pass
 
     @staticmethod
     def _gated_stream(agen, charge: _GateCharge):
@@ -515,6 +531,8 @@ class _HttpProxy:
                         except Exception:
                             pass
                     if _is_overload_error(e):
+                        self._sheds.inc(tags={"reason": "replica"})
+                        self._note_shed(path)
                         return ("503 Service Unavailable", json.dumps(
                             {"error": f"{type(e).__name__}: {e}"}).encode(),
                             None)
@@ -525,6 +543,13 @@ class _HttpProxy:
             return "404 Not Found", json.dumps(
                 {"error": f"no deployment named {path!r}"}).encode(), None
         except Exception as e:
+            if _is_overload_error(e):
+                # replica-side admission shed on the unary path: a real
+                # 503 (retriable), not a 500 — and autoscale pressure
+                self._sheds.inc(tags={"reason": "replica"})
+                self._note_shed(path)
+                return "503 Service Unavailable", json.dumps(
+                    {"error": f"{type(e).__name__}: {e}"}).encode(), None
             return "500 Internal Server Error", json.dumps(
                 {"error": f"{type(e).__name__}: {e}"}).encode(), None
         try:

@@ -63,20 +63,32 @@ _forward_cache: Dict[int, Any] = {}
 
 
 def _jit_forward(model, params, k, v, tokens, slots, ctx, ctx_pos,
-                 ctx_mask, q_pos, last_idx):
-    """One forward over the paged cache -> (greedy next tokens at
-    ``last_idx``, updated pools).  Jitted ONCE per (model, shapes) —
-    the flax module is a hashable static argument, so every engine
-    instance with the same config shares the compiled executable
-    (k/v pools donated: in-place cache updates)."""
+                 ctx_mask, q_pos, last_idx, temperature=0.0, top_k=0,
+                 rng=None):
+    """One forward over the paged cache -> (next tokens at ``last_idx``,
+    updated pools).  Jitted ONCE per (model, shapes, sampling knobs) —
+    the flax module AND the sampling knobs are hashable static
+    arguments, so every engine instance with the same config shares the
+    compiled executable (k/v pools donated: in-place cache updates).
+
+    Sampling is a pair of jit-STATIC knobs (ISSUE 13 satellite / PR-11
+    declared headroom (d)): ``temperature == 0`` compiles the exact
+    greedy-argmax program the decode-identity tier-1 gate pins down —
+    no mask, no categorical, no rng use in the graph; ``temperature >
+    0`` compiles logits/temperature + optional static top-k mask +
+    jax.random.categorical.  Each distinct (temperature, top_k) pair is
+    its own executable; lanes within one engine always share the knobs
+    (per-lane temperatures would force them to be traced values)."""
     import jax
 
-    fn = _forward_cache.get(0)
+    key = (float(temperature), int(top_k))
+    fn = _forward_cache.get(key)
     if fn is None:
         import jax.numpy as jnp
 
         def _fwd(model, params, k, v, tokens, slots, ctx, ctx_pos,
-                 ctx_mask, q_pos, last_idx):
+                 ctx_mask, q_pos, last_idx, rng,
+                 temperature=key[0], top_k=key[1]):
             logits, pools = model.apply(
                 {"params": params}, tokens,
                 {"k": k, "v": v, "slots": slots, "ctx": ctx,
@@ -84,12 +96,22 @@ def _jit_forward(model, params, k, v, tokens, slots, ctx, ctx_pos,
                  "q_pos": q_pos})
             picked = jnp.take_along_axis(
                 logits, last_idx[:, None, None], axis=1)[:, 0]
-            return jnp.argmax(picked, axis=-1), pools
+            if temperature <= 0.0:
+                return jnp.argmax(picked, axis=-1), pools
+            scaled = picked / temperature
+            if top_k > 0:
+                kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+                scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+            return jax.random.categorical(rng, scaled, axis=-1), pools
 
-        fn = _forward_cache[0] = jax.jit(
+        fn = _forward_cache[key] = jax.jit(
             _fwd, static_argnums=0, donate_argnums=(2, 3))
+    if rng is None:
+        import jax.numpy as jnp
+
+        rng = jnp.zeros((2,), dtype="uint32")  # unused when greedy
     return fn(model, params, k, v, tokens, slots, ctx, ctx_pos, ctx_mask,
-              q_pos, last_idx)
+              q_pos, last_idx, rng)
 
 
 class _Seq:
@@ -160,7 +182,9 @@ class LLMEngine:
                  detach_grace_s: Optional[float] = None,
                  prefill_lanes: Optional[int] = None,
                  stream_flush_tokens: Optional[int] = None,
-                 dtype: Any = None):
+                 dtype: Any = None,
+                 temperature: Optional[float] = None,
+                 top_k: Optional[int] = None):
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -209,10 +233,22 @@ class LLMEngine:
                 jax.random.PRNGKey(int(seed)), dummy)["params"]
         self._params = params
         self._pools = make_kv_pools(cfg, self.num_pages * self.page_size)
+        # sampling knobs are jit-STATIC: temperature=0 (the default)
+        # compiles the exact greedy program the decode-identity gate
+        # covers; >0 adds temperature scaling + optional top-k masking
+        # + categorical sampling, seeded per engine so a fixed seed
+        # replays the same stream
+        self.temperature = float(
+            temperature if temperature is not None
+            else config.llm_temperature)
+        self.top_k = int(top_k if top_k is not None else config.llm_top_k)
+        self._sample_rng = (jax.random.PRNGKey(int(seed))
+                            if self.temperature > 0 else None)
         # the jitted stepper is shared process-wide (_jit_forward keys
-        # on the STATIC model + shapes): every engine with the same
-        # config/pool geometry reuses one executable — two compiles
-        # total in steady state (decode [B,1] and prefill [1,C])
+        # on the STATIC model + shapes + sampling knobs): every engine
+        # with the same config/pool geometry reuses one executable —
+        # two compiles total in steady state (decode [B,1] and
+        # prefill [1,C])
         self._step_fn = _jit_forward
 
         self._lock = threading.RLock()
@@ -352,6 +388,21 @@ class LLMEngine:
 
     # ------------------------------------------------------------- stepping
 
+    def _forward(self, tokens, slot_arr, ctx, ctx_pos, ctx_mask, q_pos,
+                 last_idx):
+        """One jitted forward with this engine's static sampling knobs;
+        the per-call rng split only happens on the sampling path, so
+        greedy engines run the exact pre-sampling program."""
+        rng = None
+        if self._sample_rng is not None:
+            import jax
+
+            self._sample_rng, rng = jax.random.split(self._sample_rng)
+        return self._step_fn(
+            self._model, self._params, self._pools["k"], self._pools["v"],
+            tokens, slot_arr, ctx, ctx_pos, ctx_mask, q_pos, last_idx,
+            temperature=self.temperature, top_k=self.top_k, rng=rng)
+
     def _alloc_pages(self, n: int) -> List[int]:
         pages = self._free_pages[:n]
         del self._free_pages[:n]
@@ -490,10 +541,8 @@ class LLMEngine:
                 ctx_mask[lane, :hi] = True
                 q_pos[lane, :hi - lo] = self._arange[lo:hi]
                 last_idx[lane] = hi - lo - 1
-            next_tok, self._pools = self._step_fn(
-                self._model, self._params, self._pools["k"],
-                self._pools["v"], tokens,
-                slot_arr, ctx, ctx_pos, ctx_mask, q_pos, last_idx)
+            next_tok, self._pools = self._forward(
+                tokens, slot_arr, ctx, ctx_pos, ctx_mask, q_pos, last_idx)
             next_tok = np.asarray(next_tok)
             chunk_tokens = sum(hi - lo for _s, lo, hi, *_r in prefill_args)
             step_tokens += chunk_tokens
@@ -526,9 +575,8 @@ class LLMEngine:
                 ctx_pos[lane, :n] = self._arange[:n]
                 ctx_mask[lane, :n] = True
                 q_pos[lane, 0] = seq.pos
-            next_tok, self._pools = self._step_fn(
-                self._model, self._params, self._pools["k"], self._pools["v"], tokens,
-                slot_arr, ctx, ctx_pos, ctx_mask, q_pos, last_idx)
+            next_tok, self._pools = self._forward(
+                tokens, slot_arr, ctx, ctx_pos, ctx_mask, q_pos, last_idx)
             next_tok = np.asarray(next_tok)
             with self._lock:
                 for lane, (seq, _last, _slot, _ctx) in enumerate(decode_args):
@@ -786,9 +834,10 @@ def run_llm_loop(worker, instance, *_args) -> Dict[str, Any]:
     return engine.run_loop()
 
 
-def llm_deployment(name: str = "llm", *, num_replicas: int = 1,
+def llm_deployment(name: str = "llm", *, num_replicas: Any = 1,
                    max_ongoing_requests: int = 64,
                    ray_actor_options: Optional[Dict[str, Any]] = None,
+                   autoscaling_config: Optional[Dict[str, Any]] = None,
                    **engine_kwargs):
     """Build an LLM serving Application: replicas host an
     :class:`LLMEngine` and the controller installs the pinned decode
@@ -808,5 +857,7 @@ def llm_deployment(name: str = "llm", *, num_replicas: int = 1,
     d = Deployment(_LLMCallable, name, num_replicas=num_replicas,
                    max_ongoing_requests=max_ongoing_requests,
                    ray_actor_options=dict(ray_actor_options or {}),
+                   autoscaling_config=dict(autoscaling_config)
+                   if autoscaling_config else None,
                    llm=True)
     return d.bind(**engine_kwargs)

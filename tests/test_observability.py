@@ -340,7 +340,9 @@ def test_metric_names_documented_in_readme(cluster):
                m.memory_scan_partial_gauge,
                m.object_store_breakdown_gauge,
                m.pipeline_metrics,
-               m.llm_metrics):
+               m.llm_metrics,
+               m.autoscaler_metrics,
+               m.serve_sheds_counter):
         fn()
     with m.default_registry._lock:
         names |= set(m.default_registry._metrics)
