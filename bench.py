@@ -1111,6 +1111,175 @@ def _chaos_bench(total_s=9.0, kill_at_s=2.5, conns=8):
         cluster.shutdown()
 
 
+def _tail_bench(baseline_s=2.5, stall_s=3.0, post_s=6.0, conns=8):
+    """Runs as a subprocess: 2 Serve replicas of an IDEMPOTENT echo
+    deployment with p99-hedging, steady HTTP load, and one replica's
+    worker chaos-STALLED (busy-hung, not killed — the gray failure)
+    mid-run via the worker.stall site.  Contract: p99 over the stalled
+    window stays within 2x the all-healthy baseline and ZERO requests
+    fail — hedged duplicates absorb the requests that hit the gray
+    replica and its circuit breaker evicts it from routing within a few
+    hedge delays, instead of 3 health-probe periods."""
+    import asyncio
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=4, object_store_memory=96 * 1024 * 1024)
+    try:
+        class TailEcho:
+            def __call__(self, x):
+                return {"ok": 1}
+
+            def wid(self):
+                from ray_tpu._private.worker import global_worker_or_none
+
+                return global_worker_or_none().worker_id
+
+        serve.run(serve.deployment(
+            TailEcho, name="tail_echo", num_replicas=2,
+            max_ongoing_requests=32, idempotent=True,
+            hedge_after_s="p99").bind())
+        host, port = serve.start_http()
+        _serve_http_get(host, port, 4, 50, "/tail_echo?x=1")  # warm
+
+        w = ray_tpu.api._worker()
+        replicas = [a for a in w.head.call("list_actors",
+                                           timeout=30)["actors"]
+                    if a.get("name", "").startswith("serve:tail_echo")
+                    and a["state"] == "ALIVE"]
+        victim_wid = ray_tpu.get(ray_tpu.get_actor(
+            replicas[0]["name"]).handle_request.remote("wid", (), {}),
+            timeout=30)
+
+        results = []  # (t_rel, ok, latency_s)
+        t0 = time.perf_counter()
+        stall_at = [0.0]
+        total_s = baseline_s + post_s
+
+        async def injector():
+            await asyncio.sleep(baseline_s)
+            stall_at[0] = time.perf_counter() - t0
+            w.head.call("chaos", op="inject",
+                        rule={"site": "worker.stall", "action": "stall",
+                              "target": victim_wid, "count": 1,
+                              "delay_s": stall_s}, timeout=30)
+
+        async def client():
+            req = b"GET /tail_echo?x=1 HTTP/1.1\r\nHost: bench\r\n\r\n"
+            while time.perf_counter() - t0 < total_s:
+                try:
+                    reader, writer = await asyncio.open_connection(host,
+                                                                   port)
+                except OSError:
+                    results.append((time.perf_counter() - t0, False, 0.0))
+                    await asyncio.sleep(0.05)
+                    continue
+                try:
+                    while time.perf_counter() - t0 < total_s:
+                        ts = time.perf_counter()
+                        writer.write(req)
+                        await writer.drain()
+                        status = await reader.readline()
+                        if not status:
+                            results.append((ts - t0, False, 0.0))
+                            break
+                        clen = 0
+                        while True:
+                            h = await reader.readline()
+                            if h in (b"\r\n", b"\n", b""):
+                                break
+                            if h.lower().startswith(b"content-length:"):
+                                clen = int(h.split(b":", 1)[1])
+                        if clen:
+                            await reader.readexactly(clen)
+                        dt = time.perf_counter() - ts
+                        results.append((ts - t0, b"200" in status, dt))
+                except (OSError, asyncio.IncompleteReadError):
+                    results.append((time.perf_counter() - t0, False, 0.0))
+                finally:
+                    try:
+                        writer.close()
+                    except Exception:
+                        pass
+
+        async def drive():
+            await asyncio.wait_for(
+                asyncio.gather(injector(),
+                               *[client() for _ in range(conns)],
+                               return_exceptions=True),
+                timeout=total_s + 60)
+
+        asyncio.run(drive())
+        # the contract is only meaningful if the stall actually fired:
+        # a failed injection would measure healthy traffic twice and
+        # report a vacuous pass.  Fired counts ride agent heartbeats to
+        # the head (~3s period) — wait one out.
+        deadline = time.perf_counter() + 15
+        fired = 0
+        while time.perf_counter() < deadline and not fired:
+            st = w.head.call("chaos", op="status", timeout=30)
+            fired = sum(int(r.get("fired", 0)) for r in st["rules"])
+            if not fired:
+                time.sleep(0.5)
+        if not fired:
+            raise RuntimeError("worker.stall rule never fired; the "
+                               "tail numbers would be vacuous")
+
+        def p99(vals):
+            if not vals:
+                return 0.0
+            vals = sorted(vals)
+            return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
+
+        # healthy = COMPLETED before the stall landed: a request still
+        # in flight when the stall hit would smuggle multi-second
+        # latencies into the baseline and make the <=2x ratio vacuous
+        healthy = [dt for ts, ok, dt in results
+                   if ok and stall_at[0] > 0 and ts + dt < stall_at[0]]
+        stalled = [dt for ts, ok, dt in results
+                   if ok and ts >= stall_at[0] > 0]
+        failed = sum(1 for _ts, ok, _dt in results if not ok)
+        base_p99, stall_p99 = p99(healthy), p99(stalled)
+        out = {
+            "tail_requests_total": len(results),
+            "tail_failed_requests": failed,
+            "tail_p99_healthy_ms": round(base_p99 * 1000, 2),
+            "tail_p99_stalled_ms": round(stall_p99 * 1000, 2),
+            # the acceptance ratio: <= 2.0 with zero failures means the
+            # hedge + circuit breaker absorbed the gray replica
+            "tail_p99_ratio": round(stall_p99 / max(base_p99, 1e-9), 2),
+        }
+        print("TAILJSON " + json.dumps(out))
+    finally:
+        try:
+            serve.shutdown_http()
+        except Exception:
+            pass
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+
+
+def bench_tail_subprocess():
+    """Launch the tail-tolerance phase in a plugin-free CPU subprocess
+    (its own in-process cluster; the chaos stall must never touch the
+    main bench cluster's workers)."""
+    from __graft_entry__ import _clean_subprocess_env
+
+    env = _clean_subprocess_env(1)
+    proc = subprocess.run(
+        [sys.executable, "-S", os.path.join(REPO, "bench.py"),
+         "--tail-bench"], env=env, capture_output=True, text=True,
+        timeout=300, cwd=REPO)
+    for line in proc.stdout.splitlines():
+        if line.startswith("TAILJSON "):
+            return json.loads(line[len("TAILJSON "):])
+    raise RuntimeError(
+        f"tail bench rc={proc.returncode}: {proc.stderr[-400:]}")
+
+
 def _autoscale_bench(total_s=18.0, conns=16):
     """Runs as a subprocess: a 1-node AutoscalingCluster (head only),
     Serve deployment with num_replicas="auto" whose replicas can only
@@ -1546,6 +1715,11 @@ def main():
     # contract: chaos_availability_pct >= 99 (handle-level dead-replica
     # retry keeps clients whole while the controller re-heals)
     phase("chaos_recovery", lambda: extras.update(bench_chaos_subprocess()))
+    # tail_tolerance: chaos-stall one of two Serve replicas under load;
+    # contract: tail_p99_ratio <= 2.0 (stalled-window p99 vs healthy
+    # baseline) with tail_failed_requests == 0 — hedging + the circuit
+    # breaker absorb the gray replica
+    phase("tail_tolerance", lambda: extras.update(bench_tail_subprocess()))
     # autoscale: ramp Serve HTTP load against a 1-node autoscaling
     # cluster; contract: autoscale_availability_pct >= 99 through both
     # the scale-up and the drain-based scale-down event
@@ -1580,6 +1754,9 @@ if __name__ == "__main__":
     elif "--chaos-bench" in sys.argv:
         sys.path.insert(0, REPO)
         _chaos_bench()
+    elif "--tail-bench" in sys.argv:
+        sys.path.insert(0, REPO)
+        _tail_bench()
     elif "--autoscale-bench" in sys.argv:
         sys.path.insert(0, REPO)
         _autoscale_bench()

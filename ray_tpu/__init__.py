@@ -7,6 +7,7 @@ Core surface (reference: python/ray/__init__.py):
 """
 
 from ray_tpu._private.errors import (ActorDiedError, ActorUnavailableError,
+                                     DeadlineExceededError,
                                      DeploymentFailedError, GetTimeoutError,
                                      ObjectFreedError, ObjectLostError,
                                      RayError, RayTaskError, RayWorkerError,
@@ -30,6 +31,6 @@ __all__ = [
     "RayError", "RayTaskError", "RayWorkerError", "ActorDiedError",
     "ActorUnavailableError", "ObjectLostError", "ObjectFreedError",
     "GetTimeoutError", "SchedulingError", "RuntimeEnvSetupError",
-    "TaskCancelledError", "DeploymentFailedError",
+    "TaskCancelledError", "DeploymentFailedError", "DeadlineExceededError",
     "__version__",
 ]

@@ -199,6 +199,24 @@ _def("serve_autoscale_down_delay_s", 10.0)
 # --- LLM sampling (jit-static decode knobs; see serve/llm.py) ----------------
 _def("llm_temperature", 0.0)  # 0 = greedy argmax (the decode-identity tier)
 _def("llm_top_k", 0)          # 0 = full vocab; >0 = sample among top-k
+# --- end-to-end deadlines (see _private/deadlines.py) ------------------------
+# owner-side deadline sweep cadence: how often queued/in-flight tasks
+# with deadlines are checked (the sweep only runs while any exist)
+_def("deadline_check_interval_ms", 50)
+# after the cooperative cancel of a deadline-expired RUNNING task, how
+# long before the force path (worker exit) fires if it is still running
+_def("deadline_force_cancel_grace_s", 1.0)
+# --- serve tail tolerance (see serve/api.py) ---------------------------------
+# hedge delay used by hedge_after="p99" until enough latency samples
+# exist to compute a real p99 (and its floor thereafter)
+_def("serve_hedge_min_delay_s", 0.05)
+# per-replica circuit breaker: failure score (time-decayed; errors and
+# hedge-slow events each add 1) at which the circuit opens, the decay
+# horizon, and how long an open circuit waits before one half-open
+# probe is let through
+_def("serve_circuit_fail_threshold", 3.0)
+_def("serve_circuit_decay_s", 5.0)
+_def("serve_circuit_cooldown_s", 1.0)
 # --- distributed tracing (see _private/tracing.py) ---------------------------
 _def("tracing_enabled", True)
 _def("trace_sampling_ratio", 1.0)      # root-span sampling probability

@@ -57,6 +57,25 @@ class GetTimeoutError(RayError, TimeoutError):
     """ray_tpu.get(..., timeout=...) expired."""
 
 
+class DeadlineExceededError(RayError, TimeoutError):
+    """The request's end-to-end deadline (``.options(timeout_s=...)``
+    or an ``X-Request-Deadline-Ms`` ingress header) expired before the
+    work completed.  Raised owner-side for tasks still queued, by the
+    deadline sweep for running tasks, by ``get()`` when the ambient
+    budget runs out, and by the LLM engine at admission when the
+    remaining budget cannot cover prefill + one decode step
+    (see _private/deadlines.py)."""
+
+    def __init__(self, message: str = "deadline exceeded",
+                 where: str = ""):
+        self.where = where  # queued | running | get | admission
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (type(self), (str(self.args[0]) if self.args else
+                             "deadline exceeded", self.where))
+
+
 class SchedulingError(RayError):
     """The task's resource demand can never be satisfied by the cluster."""
 

@@ -20,8 +20,20 @@ happen:
                    scheduling + node_agent request_lease) — action: delay
   ``worker.kill``  node agent SIGKILLs one of its worker processes
                    (node_agent.py; key = worker_id) — action: kill
+  ``worker.stall`` node agent tells a worker to busy-hang its RPC loop
+                   for ``delay_s`` seconds (key = worker_id) — action:
+                   stall.  The worker stays ALIVE (heartbeats, probes
+                   answered late, nothing crashes): the GRAY-failure
+                   generator, distinct from kill
   ``agent.kill``   node agent SIGKILLs itself (key = node_id) — action:
                    kill
+  ``head.kill``    head service SIGKILLs itself (key = "head") —
+                   action: kill.  Exercises the GCS fault-tolerance
+                   paths (agent re-register, driver retry window)
+                   under `rtpu chaos`.  Like the other kill/stall
+                   sites, the rule is evaluated when the rule set is
+                   (re-)applied, not per request — ``p``/``at`` index
+                   over rule applications, not invocations
 
 Rules are installed process-locally (``install``/``inject``) or cluster-
 wide through the head's ``chaos`` RPC (`rtpu chaos inject|schedule|
@@ -51,8 +63,9 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 SITES = ("rpc.send", "rpc.recv", "xfer.send", "lease.grant",
-         "worker.kill", "agent.kill")
-ACTIONS = ("drop", "delay", "sever", "truncate", "corrupt", "kill")
+         "worker.kill", "worker.stall", "agent.kill", "head.kill")
+ACTIONS = ("drop", "delay", "sever", "truncate", "corrupt", "kill",
+           "stall")
 
 _rule_ids = itertools.count(1)
 
@@ -262,7 +275,8 @@ def make_schedule(seed: int, sites: Sequence[str],
     asserts."""
     default_action = {"rpc.send": "drop", "rpc.recv": "drop",
                       "xfer.send": "truncate", "lease.grant": "delay",
-                      "worker.kill": "kill", "agent.kill": "kill"}
+                      "worker.kill": "kill", "worker.stall": "stall",
+                      "agent.kill": "kill", "head.kill": "kill"}
     rng = random.Random(seed)
     rules: List[Dict[str, Any]] = []
     for site in sites:

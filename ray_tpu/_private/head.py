@@ -997,6 +997,7 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
             self._chaos_fired.clear()  # counts restart with the rule set
             fault_injection.install(self._chaos_rules, self._chaos_version)
             self._broadcast_chaos()
+            self._maybe_chaos_die()
         # aggregate cluster-wide firing counts: the head's own process
         # plus the latest per-agent heartbeat reports
         fired: Dict[str, int] = dict(fault_injection.fired_counts())
@@ -1006,6 +1007,25 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         rules = [dict(r, fired=fired.get(r.get("rule_id", ""), 0))
                  for r in self._chaos_rules]
         return {"version": self._chaos_version, "rules": rules}
+
+    def _maybe_chaos_die(self) -> None:
+        """``head.kill`` chaos site (the agent.kill pattern applied to
+        the head): SIGKILL this process after a short delay so the
+        inject reply and the rule gossip flush first.  The cluster
+        rides the existing GCS fault-tolerance paths — agents
+        re-register on their next heartbeat against a restarted head,
+        drivers retry inside gcs_reconnect_grace_s (test_gcs_ft.py)."""
+        from ray_tpu._private import fault_injection
+
+        chaos = fault_injection.decide("head.kill", key="head")
+        if chaos is None or chaos.action != "kill":
+            return
+        import os
+        import signal
+
+        delay = max(chaos.delay_s, 0.2)
+        asyncio.get_event_loop().call_later(
+            delay, lambda: os.kill(os.getpid(), signal.SIGKILL))
 
     def _chaos_payload(self) -> Dict[str, Any]:
         return {"rules": list(self._chaos_rules),

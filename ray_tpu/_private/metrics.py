@@ -598,6 +598,54 @@ def serve_sheds_counter() -> Counter:
     return _serve_sheds_counter
 
 
+_deadline_counter: Optional[Counter] = None
+
+
+def deadline_metrics() -> Counter:
+    """Process-singleton ``ray_tpu_deadline_exceeded_total``: requests
+    /tasks failed because their end-to-end deadline expired, labeled by
+    enforcement site — where=queued (failed fast without dispatching:
+    owner pump, agent lease queue, or worker task queue), running (the
+    owner's deadline sweep cancelled an in-flight task), get (a
+    ``get()`` bounded by the ambient budget ran out), admission (the
+    LLM engine refused a sequence whose remaining budget cannot cover
+    prefill + one decode step).  A rising queued share means work is
+    arriving already-doomed — shed earlier; a rising running share
+    means budgets are too tight for the service time."""
+    global _deadline_counter
+    if _deadline_counter is None:
+        _deadline_counter = Counter(
+            "ray_tpu_deadline_exceeded_total",
+            "deadline expiries by enforcement site "
+            "(queued|running|get|admission)")
+    return _deadline_counter
+
+
+_serve_tail_metrics: Optional[Tuple[Counter, Counter]] = None
+
+
+def serve_tail_metrics() -> Tuple[Counter, Counter]:
+    """Process-singleton Serve tail-tolerance counters (serve/api.py):
+    ``ray_tpu_serve_hedges_total`` — hedged duplicate requests fired
+    against a second replica, labeled outcome=won (the hedge's response
+    was used; the primary was slow) or lost (the primary finished
+    first; the hedge was cancelled).  A high won share marks a gray
+    replica the circuit breaker should be evicting.
+    ``ray_tpu_serve_circuit_open_total`` — per-replica circuit-breaker
+    open transitions (a replica's windowed error/slow score crossed the
+    threshold and it was removed from routing until a half-open probe
+    re-admits it), labeled by deployment."""
+    global _serve_tail_metrics
+    if _serve_tail_metrics is None:
+        _serve_tail_metrics = (
+            Counter("ray_tpu_serve_hedges_total",
+                    "hedged serve requests by outcome (won|lost)"),
+            Counter("ray_tpu_serve_circuit_open_total",
+                    "per-replica circuit breaker open transitions"),
+        )
+    return _serve_tail_metrics
+
+
 _serve_request_latency: Optional[Histogram] = None
 
 

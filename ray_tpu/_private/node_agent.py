@@ -480,6 +480,7 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
             return
         for wid, w in list(self._workers.items()):
             self._maybe_chaos_kill_worker(wid, w)
+            self._maybe_chaos_stall_worker(wid, w)
 
     def _maybe_chaos_kill_worker(self, worker_id: str, w: "_Worker") -> None:
         chaos = fault_injection.decide("worker.kill", key=worker_id)
@@ -491,6 +492,33 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
             pass
         # the reap loop notices the death within its 0.2s poll and runs
         # the normal worker-death path (lease release, head report)
+
+    def _maybe_chaos_stall_worker(self, worker_id: str,
+                                  w: "_Worker") -> None:
+        """``worker.stall``: the gray-failure site.  The worker is told
+        to busy-hang its RPC IO loop for the rule's delay_s — it stays
+        ALIVE (process up, heartbeats fine) but every push, reply, and
+        stream item stalls, which is exactly what a replica wedged in
+        GC / a stalled decode loop looks like from outside.  Distinct
+        from worker.kill: nothing crashes, nothing restarts — only
+        deadline/hedging/circuit-breaker layers can route around it."""
+        chaos = fault_injection.decide("worker.stall", key=worker_id)
+        if chaos is None or chaos.action != "stall":
+            return
+
+        async def _stall():
+            try:
+                if not w.ready.is_set() or not w.port:
+                    await asyncio.wait_for(w.ready.wait(), timeout=30)
+                c = RpcClient("127.0.0.1", w.port,
+                              label=f"stall-{worker_id[:8]}")
+                # oneway: the stalled loop cannot reply until it wakes
+                await c.oneway("chaos_stall", duration_s=chaos.delay_s)
+                await c.close()
+            except Exception:
+                pass  # worker died first: nothing to stall
+
+        asyncio.ensure_future(_stall())
 
     def _metric_summary(self) -> Dict[str, float]:
         """Small per-node gauge snapshot piggybacked on every heartbeat;
@@ -1096,9 +1124,11 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         w = self._workers.get(worker_id)
         if w is None:
             return {"ok": False}
-        # an armed worker.kill rule also catches workers born after it
+        # armed worker.kill / worker.stall rules also catch workers
+        # born after them
         self._maybe_chaos_kill_worker(worker_id, w)
         w.port = port
+        self._maybe_chaos_stall_worker(worker_id, w)
         self._starting = max(0, self._starting - 1)
         if not w.ready.is_set():
             w.ready.set()
@@ -1603,14 +1633,31 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
                                  req_id: str = ""):
         if sched.try_acquire(demand):
             return await self._grant_safe(sched, demand, bundle_key, ts, conn)
+        # a deadlined spec queues only for its remaining budget: a lease
+        # request whose task can no longer finish in time is dropped
+        # from the FIFO and the owner notified (it fails the expired
+        # tasks fast instead of letting them camp on this agent's queue)
+        wait_s = config.worker_lease_timeout_ms / 1000.0
+        dl = ts.deadline if ts is not None else 0.0
+        if dl:
+            rem = dl - time.time()
+            if rem <= 0:
+                return {"error": "deadline exceeded",
+                        "error_str": "task deadline expired before a "
+                                     "worker lease was available"}
+            wait_s = min(wait_s, rem)
         status = await self._queue_for_resources(
-            sched, demand, config.worker_lease_timeout_ms / 1000.0,
+            sched, demand, wait_s,
             cancel_key=req_id or None, registry=self._lease_req_tokens)
         if status == "canceled":
             # owner's demand drained before a grant; nothing was acquired
             return {"error": "canceled",
                     "error_str": "lease request canceled by owner"}
         if status == "timeout":
+            if dl and time.time() >= dl:
+                return {"error": "deadline exceeded",
+                        "error_str": "task deadline expired while queued "
+                                     "for a worker lease"}
             return {"error": "lease timeout",
                     "error_str": "timed out waiting for resources"}
         if bundle_key and bundle_key not in self._bundles:

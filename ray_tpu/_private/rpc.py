@@ -18,9 +18,10 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import random
 import struct
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Iterator, Optional
 
 import msgpack
 
@@ -37,6 +38,25 @@ def is_loopback(host: Any) -> bool:
     never drift into classifying the same address differently."""
     h = str(host)
     return h.startswith("127.") or h in ("localhost", "::1")
+
+
+def backoff_delays(base_s: float = 0.05, cap_s: float = 1.0,
+                   rng: Optional[random.Random] = None
+                   ) -> Iterator[float]:
+    """Reconnect/refusal-retry schedule: exponential backoff with
+    jitter, capped.  Each draw is uniform in [ceiling/2, ceiling] with
+    the ceiling doubling from ``base_s`` up to ``cap_s`` — so a head
+    restart with hundreds of agents/drivers in the retry loop does not
+    produce a synchronized dial storm every N ms (every client draws
+    its own phase), while the half-ceiling floor keeps the loop from
+    hot-spinning against a refused socket.  ``rng`` is injectable so
+    the schedule is unit-testable deterministically."""
+    draw = (rng or random).uniform
+    ceiling = max(1e-6, float(base_s))
+    cap_s = max(float(cap_s), ceiling)
+    while True:
+        yield draw(ceiling / 2.0, ceiling)
+        ceiling = min(ceiling * 2.0, cap_s)
 
 
 class RpcError(Exception):
@@ -421,9 +441,11 @@ class SyncRpcClient:
     """Blocking facade over RpcClient for use from the main thread.
 
     With ``retry_lost_s`` > 0, calls that fail on connection loss or
-    refusal retry (with backoff) until the window closes — this is what
-    lets drivers and workers ride out a head restart
-    (reference: gcs_rpc_client.h retryable GCS client).
+    refusal retry until the window closes — this is what lets drivers
+    and workers ride out a head restart (reference: gcs_rpc_client.h
+    retryable GCS client).  The retry schedule is ``backoff_delays``:
+    exponential with jitter, capped — many clients riding out the same
+    head restart desynchronize instead of dialing in lockstep.
     """
 
     def __init__(self, host: str, port: int, io: EventLoopThread, on_push=None,
@@ -445,7 +467,7 @@ class SyncRpcClient:
         # cannot block the caller forever.
         inner = timeout if timeout is not None else config.rpc_call_timeout_s
         deadline = _time.monotonic() + self._retry_lost_s
-        delay = 0.05
+        delays = backoff_delays()
         while True:
             try:
                 return self._io.run(
@@ -456,8 +478,8 @@ class SyncRpcClient:
                     asyncio.TimeoutError):
                 if _time.monotonic() >= deadline:
                     raise
-                _time.sleep(min(delay, max(0.0, deadline - _time.monotonic())))
-                delay = min(delay * 2, 1.0)
+                _time.sleep(min(next(delays),
+                                max(0.0, deadline - _time.monotonic())))
 
     def oneway(self, method: str, **payload) -> None:
         from ray_tpu._private.config import config

@@ -100,6 +100,12 @@ class TaskSpec:
     # only present for sampled traces — the worker-side execute span
     # parents to it (see _private/tracing.py)
     trace_ctx: Optional[Dict[str, str]] = None
+    # absolute wall-clock deadline (epoch seconds; 0.0 = unbounded),
+    # stamped at submission from .options(timeout_s=...) / the ambient
+    # deadline context and re-activated by the executing worker so
+    # nested .remote() calls inherit the caller's remaining budget
+    # (see _private/deadlines.py)
+    deadline: float = 0.0
 
     def resource_set(self) -> ResourceSet:
         return ResourceSet(self.resources)
@@ -144,6 +150,8 @@ class TaskSpec:
         }
         if self.trace_ctx:
             d["trace"] = self.trace_ctx
+        if self.deadline:
+            d["dl"] = self.deadline
         return d
 
     @classmethod
@@ -171,4 +179,5 @@ class TaskSpec:
             runtime_env=d.get("renv", {}),
             scheduling_strategy=d.get("strat", {}),
             trace_ctx=d.get("trace"),
+            deadline=d.get("dl", 0.0),
         )

@@ -42,10 +42,11 @@ from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 import cloudpickle
 from concurrent.futures import CancelledError as _futures_cancelled
 
-from ray_tpu._private import serialization
+from ray_tpu._private import deadlines, serialization
 from ray_tpu._private.config import config
 from ray_tpu._private.errors import (TaskCancelledError,
-                                     ActorDiedError, GetTimeoutError,
+                                     ActorDiedError, DeadlineExceededError,
+                                     GetTimeoutError,
                                      ObjectFreedError, ObjectLostError,
                                      RayTaskError, RayWorkerError,
                                      RuntimeEnvSetupError, SchedulingError)
@@ -320,7 +321,8 @@ class _ServiceStats:
 class _SchedState:
     __slots__ = ("key", "pending", "staged", "lock", "leases",
                  "inflight_requests", "stats", "request_agents",
-                 "req_counter", "pump_queued", "defer_timer", "req_rr")
+                 "req_counter", "pump_queued", "defer_timer", "req_rr",
+                 "has_deadlines")
 
     def __init__(self, key: tuple = ()):
         self.key = key
@@ -352,6 +354,10 @@ class _SchedState:
         # in ONE pump (forming real push_tasks batches) instead of one
         # pump per submission; guarded by `lock`
         self.pump_queued = False
+        # sticky: this class has seen a deadlined task, so the pump
+        # pays the pre-dispatch expiry scan (undeadlined classes never
+        # do — the scan would be O(pending) on the burst hot path)
+        self.has_deadlines = False
 
 
 class _ActorState:
@@ -509,6 +515,15 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
         # normal tasks whose ref args are still resolving (not yet in any
         # pending queue) — cancellable through here
         self._resolving_tasks: Dict[str, _TaskState] = {}
+        # end-to-end deadlines (_private/deadlines.py): the sweep timer
+        # runs only while deadlined tasks exist (armed at submit, self-
+        # re-arming while it finds any); _deadline_resolved marks tasks
+        # the sweep already failed owner-side so the worker's eventual
+        # reply (value, or the cancel-induced error) is discarded
+        # instead of overwriting the DeadlineExceededError — and so the
+        # next sweep tick doesn't re-fail/re-cancel them
+        self._deadline_sweep_handle = None
+        self._deadline_resolved: Set[str] = set()
         # cancellation (reference: core_worker CancelTask):
         # owner side — task_ids we force-cancelled (their worker death
         # must surface TaskCancelledError, never a retry)
@@ -1233,6 +1248,14 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
         # without this, task nesting deeper than the node's CPU count
         # deadlocks.  Fast path (everything already resolved) skips the
         # agent round-trip entirely.
+        # the ambient request deadline caps the budget: a get() inside a
+        # deadlined task (or a Serve request) spends only what remains,
+        # and its expiry surfaces as the typed DeadlineExceededError
+        ambient = deadlines.remaining()
+        deadline_bound = ambient is not None and (timeout is None
+                                                  or ambient < timeout)
+        if deadline_bound:
+            timeout = ambient
         # the deadline starts NOW — the blocked-notification RPC below
         # must not eat into the caller's budget
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -1246,6 +1269,13 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             self._notify_blocked(True)
         try:
             return self._get_inner(refs, deadline)
+        except GetTimeoutError as e:
+            if deadline_bound:
+                deadlines.count_exceeded("get")
+                raise DeadlineExceededError(
+                    f"request deadline expired while waiting: {e}",
+                    where="get") from e
+            raise
         finally:
             if notify:
                 self._notify_blocked(False)
@@ -1763,7 +1793,8 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                     runtime_env: Optional[Dict[str, Any]] = None,
                     scheduling_strategy: Optional[Dict[str, Any]] = None,
                     placement_group_id: str = "",
-                    bundle_index: int = -1) -> List[ObjectRef]:
+                    bundle_index: int = -1,
+                    timeout_s: Optional[float] = None) -> List[ObjectRef]:
         from ray_tpu._private.runtime_env import merge as _renv_merge
 
         if num_returns == "streaming":
@@ -1778,7 +1809,8 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             runtime_env=_renv_merge(self.job_runtime_env, runtime_env or {}),
             scheduling_strategy=scheduling_strategy or {},
             placement_group_id=placement_group_id,
-            bundle_index=max(bundle_index, 0) if placement_group_id else -1)
+            bundle_index=max(bundle_index, 0) if placement_group_id else -1,
+            deadline=deadlines.effective_deadline(timeout_s) or 0.0)
         task = _TaskState(spec, contained)
         # submit span: child of whatever span this thread/coroutine is
         # running under (an executing task's span for nested submits, a
@@ -1815,6 +1847,11 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             # pump_queued edge); the coalesced pump forms real
             # push_tasks batches out of whatever accumulated.
             self._stage_ready(task)
+        if spec.deadline:
+            # AFTER the enqueue: arming first would let a concurrent
+            # sweep tick scan-and-disarm in the gap and never see this
+            # task (the arm's racy handle read would then skip re-arming)
+            self._arm_deadline_sweep()
         if span is not None:
             span.end()
         return refs
@@ -1830,6 +1867,8 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
     def _stage_ready(self, task: _TaskState) -> None:
         state = self._sched_state(task.sched_key)
         with state.lock:
+            if task.spec.deadline:
+                state.has_deadlines = True
             state.staged.append(task)
             if state.pump_queued:
                 return
@@ -1857,6 +1896,8 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
         if not ok or task.cancelled:
             return
         state = self._sched_state(task.sched_key)
+        if task.spec.deadline:
+            state.has_deadlines = True
         state.pending.append(task)
         self._pump(state)
 
@@ -1964,6 +2005,13 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
         it as cancelled.  Used by the connection-failure handlers: the
         worker's death IS the cancellation outcome, never a retryable
         fault."""
+        if task.spec.task_id in self._deadline_resolved:
+            # the deadline sweep already resolved this task with
+            # DeadlineExceededError — consume every mark and report
+            # "handled" so no path overwrites or retries it
+            self._deadline_resolved.discard(task.spec.task_id)
+            self._cancelled_tasks.discard(task.spec.task_id)
+            return True
         if task.spec.task_id not in self._cancelled_tasks:
             return False
         self._cancelled_tasks.discard(task.spec.task_id)
@@ -2005,6 +2053,147 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
         with self._lineage_lock:
             self._reconstructing.discard(task.spec.task_id)
         task.contained_refs = []
+
+    # ------------------------------------------------------ deadline sweep
+
+    def _arm_deadline_sweep(self) -> None:
+        """Called from submit paths (any thread) when a deadlined task
+        enters the system: make sure the owner-side sweep timer is
+        running.  The sweep self-re-arms while any deadlined work
+        exists and dies when none does, so undeadlined workloads never
+        pay for it."""
+        if self._deadline_sweep_handle is not None or self._shutdown:
+            return  # racy read is fine: the loop-side ensure re-checks
+        try:
+            self._loop().call_soon_threadsafe(self._ensure_deadline_sweep)
+        except RuntimeError:
+            pass  # loop shut down
+
+    def _ensure_deadline_sweep(self) -> None:
+        if self._deadline_sweep_handle is None and not self._shutdown:
+            self._deadline_sweep_handle = self._loop().call_later(
+                config.deadline_check_interval_ms / 1000.0,
+                self._deadline_sweep_tick)
+
+    def _fail_deadline(self, task: _TaskState, where: str) -> None:
+        """Resolve a task as deadline-exceeded owner-side.  For tasks
+        still queued this IS fail-fast (never dispatched — no reply
+        will ever come, so nothing to track); for running tasks the
+        caller additionally fires the cancel path and the late worker
+        reply is discarded via _deadline_resolved (tracking queued
+        expiries there would grow the set forever)."""
+        task.retries_left = 0
+        task.cancelled = True
+        if where == "running":
+            self._deadline_resolved.add(task.spec.task_id)
+        deadlines.count_exceeded(where)
+        self._fail_task(task, DeadlineExceededError(
+            f"task {task.spec.name or task.spec.method_name or task.spec.task_id[:12]} "
+            f"exceeded its deadline while {where}", where=where))
+
+    def _deadline_sweep_tick(self) -> None:
+        """One sweep over every owner-side queue and in-flight set:
+        expired queued tasks fail fast without dispatching; expired
+        running tasks are resolved NOW (the caller's get() unblocks at
+        the deadline, not at cancel completion) and cancelled on their
+        worker — cooperative first, the existing force path after
+        deadline_force_cancel_grace_s."""
+        self._deadline_sweep_handle = None
+        now = time.time()
+        live = False
+        resolved = self._deadline_resolved
+        # 0. args still resolving (in no queue yet)
+        for task in list(self._resolving_tasks.values()):
+            dl = task.spec.deadline
+            if not dl or task.spec.task_id in resolved:
+                continue
+            if now >= dl:
+                self._fail_deadline(task, "queued")
+            else:
+                live = True
+        # 1. normal-task classes: staged, pending, leased-and-inflight
+        for state in list(self._sched.values()):
+            expired: List[_TaskState] = []
+            with state.lock:
+                for t in list(state.staged):
+                    if t.spec.deadline and now >= t.spec.deadline:
+                        state.staged.remove(t)
+                        expired.append(t)
+                    elif t.spec.deadline:
+                        live = True
+            for t in list(state.pending):
+                if t.spec.deadline and now >= t.spec.deadline:
+                    state.pending.remove(t)
+                    expired.append(t)
+                elif t.spec.deadline:
+                    live = True
+            for t in expired:
+                self._fail_deadline(t, "queued")
+            for lease in list(state.leases):
+                for t in list(lease.inflight):
+                    dl = t.spec.deadline
+                    if not dl or t.spec.task_id in resolved:
+                        continue
+                    if now >= dl:
+                        self._fail_deadline(t, "running")
+                        self._spawn(self._deadline_cancel(t, lease.addr))
+                    else:
+                        live = True
+        # 2. actor calls: pending + inflight
+        for astate in list(self._actors.values()):
+            for t in list(astate.pending):
+                if t.spec.deadline and now >= t.spec.deadline:
+                    try:
+                        astate.pending.remove(t)
+                    except ValueError:
+                        continue
+                    self._fail_deadline(t, "queued")
+                elif t.spec.deadline:
+                    live = True
+            for t in list(astate.inflight.values()):
+                dl = t.spec.deadline
+                if not dl or t.spec.task_id in resolved:
+                    continue
+                if now >= dl:
+                    self._fail_deadline(t, "running")
+                    if astate.addr:
+                        self._spawn(self._deadline_cancel(t, astate.addr))
+                else:
+                    live = True
+        if live:
+            self._ensure_deadline_sweep()
+
+    async def _deadline_cancel(self, task: _TaskState,
+                               addr: Tuple[str, int]):
+        """Cancel a deadline-expired RUNNING task on its worker: the
+        cooperative interrupt first (async-exc / coroutine cancel at
+        the next bytecode), then — if it is STILL running after the
+        grace — the existing force path (worker exit; queued tasks
+        behind it requeue for free via _account_push_death)."""
+        tid = task.spec.task_id
+        try:
+            c = await self._aclient_worker(addr)
+            await c.call("cancel_task", task_id=tid, force=False,
+                         timeout=10.0)
+        except ConnectionLost:
+            return  # worker gone: the push failure path resolves it
+        except Exception:
+            # a TIMEOUT here is the gray case the force path exists
+            # for (a worker wedged in native code / chaos-stalled never
+            # answers the cooperative RPC) — fall through to force
+            pass
+        grace = float(config.deadline_force_cancel_grace_s)
+        if grace > 0:
+            await self._sleep(grace)
+        self._cancelled_tasks.add(tid)
+        try:
+            c = await self._aclient_worker(addr)
+            r = await c.call("cancel_task", task_id=tid, force=True,
+                             timeout=10.0)
+            if not r.get("ok"):
+                self._cancelled_tasks.discard(tid)  # already finished
+        except Exception:
+            self._cancelled_tasks.discard(tid)
 
     @staticmethod
     def _pool_key_of(sched_key: tuple) -> tuple:
@@ -2101,6 +2290,17 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             with state.lock:
                 state.pending.extend(state.staged)
                 state.staged.clear()
+        if state.has_deadlines and state.pending:
+            # fail-fast BEFORE dispatch: an expired task must never
+            # consume a lease slot (the sweep covers idle periods; this
+            # covers the moment of assignment)
+            now_w = time.time()
+            doomed = [t for t in state.pending
+                      if t.spec.deadline and now_w >= t.spec.deadline
+                      and t.spec.task_id not in self._deadline_resolved]
+            for t in doomed:
+                state.pending.remove(t)
+                self._fail_deadline(t, "queued")
         # hand pending tasks to leases at the depth the service-time
         # curve allows; adopt warm-pool leases before breaking — a
         # pooled worker beats both a deeper pipeline and a fresh lease
@@ -2341,6 +2541,12 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                     return
                 if reply.get("error") == "canceled":
                     return  # we canceled it: demand drained
+                if reply.get("error") == "deadline exceeded":
+                    # the agent dropped our queued lease request because
+                    # the spec's deadline passed: the finally's pump
+                    # fails the expired tasks fast and re-requests for
+                    # whatever demand remains
+                    return
                 # lease timeout: retry while there is still demand
                 if not state.pending:
                     return
@@ -2403,6 +2609,8 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                     return
                 if reply.get("error") == "canceled":
                     return  # we canceled it: demand drained
+                if reply.get("error") == "deadline exceeded":
+                    return  # expired spec: the finally's pump fails it
                 if not state.pending:
                     return  # lease timeout with no demand left
         finally:
@@ -2633,6 +2841,23 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
 
     async def _process_reply(self, task: _TaskState, reply: Dict[str, Any],
                              worker_addr: Tuple[str, int]):
+        if task.spec.task_id in self._deadline_resolved:
+            # the deadline sweep resolved this task while it ran; the
+            # late reply (a value, or the cancel-induced error) must
+            # not overwrite the DeadlineExceededError the caller saw.
+            # Still ack held values so the worker's pin set drains.
+            self._deadline_resolved.discard(task.spec.task_id)
+            self._cancelled_tasks.discard(task.spec.task_id)
+            if reply.get("needs_ack"):
+                try:
+                    c = await self._aclient_worker(worker_addr)
+                    await c.oneway("task_ack", task_id=task.spec.task_id)
+                except Exception:
+                    pass
+            with self._lineage_lock:
+                self._reconstructing.discard(task.spec.task_id)
+            task.contained_refs = []
+            return
         if task.spec.num_returns == STREAMING:
             # every stream_item push was dispatched before this reply
             # (same ordered connection), so arrived is final here
@@ -2793,7 +3018,9 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
 
     def submit_actor_task(self, actor_id: str, method_name: str, args: tuple,
                           kwargs: dict, num_returns: int = 1,
-                          max_retries: int = 0) -> List[ObjectRef]:
+                          max_retries: int = 0,
+                          timeout_s: Optional[float] = None
+                          ) -> List[ObjectRef]:
         if num_returns == "streaming":
             num_returns = STREAMING
         astate = self._actors.get(actor_id)
@@ -2806,7 +3033,8 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             args=wire_args, num_returns=num_returns, resources={},
             max_retries=max_retries, actor_id=actor_id,
             method_name=method_name, caller_id=self.worker_id,
-            owner_addr=self.address)
+            owner_addr=self.address,
+            deadline=deadlines.effective_deadline(timeout_s) or 0.0)
         span, spec.trace_ctx = tracing.begin_submit("submit " + method_name)
         if span is not None:
             span.set_attribute("task_id", spec.task_id)
@@ -2827,6 +3055,10 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             self._post_to_loop(self._actor_enqueue, astate, task)
         except RuntimeError:
             pass  # loop shut down
+        if spec.deadline:
+            # after the enqueue post (see submit_task): a sweep tick
+            # between arm and enqueue could otherwise disarm for good
+            self._arm_deadline_sweep()
         return refs
 
     def _actor_enqueue(self, astate: _ActorState, task: _TaskState) -> None:
@@ -3185,6 +3417,19 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                 ctypes.c_long(ident), ctypes.py_object(TaskCancelledError))
         return {"ok": True}
 
+    async def rpc_chaos_stall(self, duration_s: float = 1.0):
+        """Chaos ``worker.stall`` site (fault_injection.py): busy-hang
+        this process's RPC IO loop for ``duration_s``.  Deliberately a
+        BLOCKING sleep on the loop — every push reply, stream item, and
+        cancel RPC stalls while the process stays alive, which is the
+        gray-failure shape (a replica wedged mid-GC) that kill-based
+        chaos cannot produce.  Sent by the node agent as a oneway (the
+        stalled loop cannot reply until it wakes)."""
+        from ray_tpu._private import fault_injection
+
+        fault_injection.sleep_sync(min(float(duration_s), 600.0))
+        return {"ok": True}
+
     async def rpc_exit_worker(self):
         self._task_queue.put(None)
 
@@ -3316,6 +3561,23 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
 
     def _execute(self, spec_wire: Dict[str, Any],
                  conn=None) -> Dict[str, Any]:
+        """Deadline wrapper around the traced execute: the spec's
+        absolute deadline is re-activated on this exec thread (and, via
+        the context copy in _run_coroutine, in async task bodies) so
+        nested ``.remote()`` submissions and ``get()`` calls inside the
+        task inherit the caller's remaining budget — the same
+        propagation contract trace context has."""
+        dl = spec_wire.get("dl")
+        if not dl:
+            return self._execute_traced(spec_wire, conn)
+        token = deadlines.activate(float(dl))
+        try:
+            return self._execute_traced(spec_wire, conn)
+        finally:
+            deadlines.restore(token)
+
+    def _execute_traced(self, spec_wire: Dict[str, Any],
+                        conn=None) -> Dict[str, Any]:
         """Tracing wrapper: a sampled submission carries its context in
         the spec; the execute span parents to the caller's submit span,
         and — via the contextvar — any `.remote()` the task body makes
@@ -3376,6 +3638,18 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             return self._error_reply(
                 spec, TaskCancelledError(f"task {spec.task_id[:12]} was "
                                          "cancelled before it started"), "")
+        if spec.deadline and time.time() >= spec.deadline:
+            # expired while queued in this worker's pipeline (behind
+            # earlier tasks): fail fast without running — the owner's
+            # sweep likely resolved it already and discards this reply
+            deadlines.count_exceeded("queued")
+            self.record_task_event(spec.task_id, "FAILED",
+                                   error="deadline exceeded")
+            self._finish_exec(spec.task_id)
+            return self._error_reply(
+                spec, DeadlineExceededError(
+                    f"task {spec.task_id[:12]} exceeded its deadline "
+                    f"before it started", where="queued"), "")
         # registered BEFORE arg materialization so a cancel arriving
         # during a long remote-arg fetch interrupts it (the async exc
         # fires at the fetch loop's next bytecode) instead of being lost

@@ -238,7 +238,7 @@ class RemoteFunction:
 
     _OPT_KEYS = ("num_returns", "num_cpus", "num_gpus", "num_tpus",
                  "resources", "max_retries", "name", "runtime_env",
-                 "scheduling_strategy",
+                 "scheduling_strategy", "timeout_s",
                  "placement_group", "placement_group_bundle_index")
 
     def __init__(self, fn, **opts):
@@ -279,7 +279,8 @@ class RemoteFunction:
             name=self._name, runtime_env=_normalized_renv(self, w),
             scheduling_strategy=_strategy_wire(self._opts),
             placement_group_id=pg.id if pg is not None else "",
-            bundle_index=self._opts.get("placement_group_bundle_index", -1))
+            bundle_index=self._opts.get("placement_group_bundle_index", -1),
+            timeout_s=self._opts.get("timeout_s"))
         if self._num_returns == 1 or self._num_returns == "streaming":
             return refs[0]
         return refs
@@ -348,19 +349,31 @@ class ActorMethod:
 
     def remote(self, *args, **kwargs):
         num_returns = self._handle._method_num_returns.get(self._name, 1)
-        return self._remote_n(num_returns, *args, **kwargs)
+        return self._remote_n(num_returns, None, *args, **kwargs)
 
-    def options(self, *, num_returns: Union[int, str] = 1):
+    def options(self, *, num_returns: Union[int, str, None] = None,
+                timeout_s: Optional[float] = None):
         m = ActorMethod(self._handle, self._name)
-        m.remote = lambda *a, **kw: self._remote_n(num_returns, *a, **kw)
+
+        def call(*a, **kw):
+            # None = keep the @method(num_returns=...) annotation —
+            # options(timeout_s=...) alone must not reset return shape
+            nr = num_returns if num_returns is not None \
+                else self._handle._method_num_returns.get(self._name, 1)
+            return self._remote_n(nr, timeout_s, *a, **kw)
+
+        m.remote = call
         return m
 
-    def _remote_n(self, num_returns, *args, **kwargs):
+    def _remote_n(self, num_returns, timeout_s, *args, **kwargs):
         w = _worker()
+        if timeout_s is None:
+            timeout_s = self._handle._timeout_s
         refs = w.submit_actor_task(
             self._handle._actor_id, self._name, args, kwargs,
             num_returns=num_returns,
-            max_retries=self._handle._max_task_retries)
+            max_retries=self._handle._max_task_retries,
+            timeout_s=timeout_s)
         return refs[0] if num_returns in (1, "streaming") else refs
 
     def __call__(self, *a, **kw):
@@ -376,11 +389,15 @@ class ActorHandle:
 
     def __init__(self, actor_id: str, max_task_retries: int = 0,
                  method_num_returns: Optional[Dict[str, int]] = None,
-                 _owner: bool = False):
+                 _owner: bool = False, timeout_s: Optional[float] = None):
         self._actor_id = actor_id
         self._max_task_retries = max_task_retries
         self._method_num_returns = method_num_returns or {}
         self._owner = _owner
+        # default per-call deadline budget for every method of this
+        # handle (ActorClass.options(timeout_s=...)); a per-call
+        # ActorMethod.options(timeout_s=...) overrides it
+        self._timeout_s = timeout_s
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
@@ -389,7 +406,8 @@ class ActorHandle:
 
     def __reduce__(self):
         return (ActorHandle,
-                (self._actor_id, self._max_task_retries, self._method_num_returns))
+                (self._actor_id, self._max_task_retries,
+                 self._method_num_returns, False, self._timeout_s))
 
     def __del__(self):
         if getattr(self, "_owner", False):
@@ -410,6 +428,7 @@ class ActorClass:
     _OPT_KEYS = ("num_cpus", "num_gpus", "num_tpus", "resources",
                  "max_restarts", "max_task_retries", "max_concurrency",
                  "name", "lifetime", "runtime_env", "scheduling_strategy",
+                 "timeout_s",
                  "placement_group", "placement_group_bundle_index")
 
     def __init__(self, cls, **opts):
@@ -456,7 +475,8 @@ class ActorClass:
         owner = self._lifetime != "detached"
         return ActorHandle(actor_id, max_task_retries=self._max_task_retries,
                            method_num_returns=self._method_num_returns(),
-                           _owner=owner)
+                           _owner=owner,
+                           timeout_s=self._opts.get("timeout_s"))
 
     def _method_num_returns(self) -> Dict[str, Any]:
         """Collect @method(num_returns=...) annotations off the class

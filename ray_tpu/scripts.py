@@ -742,9 +742,9 @@ def main(argv=None) -> int:
     ci = csub.add_parser("inject", help="arm one fault-injection rule")
     ci.add_argument("--site", required=True,
                     help="rpc.send|rpc.recv|xfer.send|lease.grant|"
-                         "worker.kill|agent.kill")
+                         "worker.kill|worker.stall|agent.kill|head.kill")
     ci.add_argument("--action", required=True,
-                    help="drop|delay|sever|truncate|corrupt|kill")
+                    help="drop|delay|sever|truncate|corrupt|kill|stall")
     ci.add_argument("--p", type=float, default=1.0,
                     help="firing probability per matching invocation")
     ci.add_argument("--count", type=int, default=-1,

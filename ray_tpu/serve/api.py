@@ -184,6 +184,21 @@ class Deployment:
     # continuous-batching engine and the controller installs a pinned
     # decode loop on each one
     llm: bool = False
+    # ---- tail tolerance (per-deployment policy; see DeploymentHandle) ----
+    # end-to-end budget for one request through this deployment: callers
+    # (handle.call_async, the HTTP proxy) cap their wait AND stamp the
+    # deadline into the replica task so the whole downstream tree
+    # inherits it (an X-Request-Deadline-Ms header tightens it further)
+    request_timeout_s: Optional[float] = None
+    # hedging (IDEMPOTENT deployments only): a request still unanswered
+    # after this delay fires a duplicate against a second replica —
+    # first response wins, the loser is cancelled.  A float is a fixed
+    # delay; "p99" tracks the handle's observed p99 latency.
+    hedge_after_s: Any = None
+    # the user's promise that duplicate execution is safe; hedging is
+    # refused without it (a duplicate non-idempotent request could
+    # double-apply side effects)
+    idempotent: bool = False
 
     def options(self, **opts) -> "Deployment":
         d = Deployment(self.func_or_class, self.name, self.num_replicas,
@@ -192,10 +207,18 @@ class Deployment:
                        self.init_args, dict(self.init_kwargs),
                        dict(self.autoscaling_config)
                        if self.autoscaling_config else None,
-                       self.llm)
+                       self.llm, self.request_timeout_s,
+                       self.hedge_after_s, self.idempotent)
         for k, v in opts.items():
             setattr(d, k, v)
         return d
+
+    def policy(self) -> Dict[str, Any]:
+        """The wire form of the tail-tolerance policy (stored by the
+        controller, learned by every handle via get_replicas)."""
+        return {"request_timeout_s": self.request_timeout_s,
+                "hedge_after_s": self.hedge_after_s,
+                "idempotent": bool(self.idempotent)}
 
     def bind(self, *args, **kwargs) -> "Application":
         d = self.options()
@@ -212,12 +235,17 @@ class Application:
 def deployment(_cls: Any = None, *, name: Optional[str] = None,
                num_replicas: Any = 1, max_ongoing_requests: int = 8,
                ray_actor_options: Optional[Dict[str, Any]] = None,
-               autoscaling_config: Optional[Dict[str, Any]] = None):
+               autoscaling_config: Optional[Dict[str, Any]] = None,
+               request_timeout_s: Optional[float] = None,
+               hedge_after_s: Any = None, idempotent: bool = False):
     def make(target):
         return Deployment(target, name or getattr(target, "__name__", "app"),
                           num_replicas, max_ongoing_requests,
                           ray_actor_options or {},
-                          autoscaling_config=autoscaling_config)
+                          autoscaling_config=autoscaling_config,
+                          request_timeout_s=request_timeout_s,
+                          hedge_after_s=hedge_after_s,
+                          idempotent=idempotent)
 
     if _cls is not None:
         return make(_cls)
@@ -332,6 +360,7 @@ class ServeController:
                         "max_ongoing": app["max_ongoing"],
                         "autoscaling": app["autoscaling"],
                         "llm": app.get("llm", False),
+                        "policy": app.get("policy") or {},
                         "desired": app["desired"],
                         "version": app["version"],
                         "replica_names": list(
@@ -380,6 +409,7 @@ class ServeController:
                 "max_ongoing": spec["max_ongoing"],
                 "autoscaling": spec["autoscaling"],
                 "llm": spec.get("llm", False),
+                "policy": spec.get("policy") or {},
                 "desired": spec["desired"],
                 "replicas": replicas,
                 "replica_names": replica_names,
@@ -421,7 +451,8 @@ class ServeController:
                actor_options: Dict[str, Any],
                autoscaling: Optional[Dict[str, Any]] = None,
                health_timeout: Optional[float] = None,
-               llm: bool = False):
+               llm: bool = False,
+               policy: Optional[Dict[str, Any]] = None):
         import ray_tpu
 
         if autoscaling:
@@ -435,6 +466,7 @@ class ServeController:
             "max_ongoing": max_ongoing,
             "autoscaling": autoscaling,
             "llm": llm,
+            "policy": dict(policy or {}),
             "desired": num_replicas,
             "replicas": [],
             "replica_names": {},  # actor_id -> detached actor name
@@ -795,7 +827,8 @@ class ServeController:
             return {"version": app["version"],
                     "replica_ids": ids,
                     "replica_nodes": [nodes.get(i, "") for i in ids],
-                    "max_ongoing": app["max_ongoing"]}
+                    "max_ongoing": app["max_ongoing"],
+                    "policy": app.get("policy") or {}}
 
     def _refresh_replica_nodes(self) -> None:
         """Map replica actor ids to their nodes (for locality-aware
@@ -1068,6 +1101,126 @@ class _MetricsPusher:
 _metrics_pusher = _MetricsPusher()
 
 
+class ReplicaCircuit:
+    """Per-replica circuit breaker (reference intent: the router's
+    replica health gating; the mechanism is the classic three-state
+    breaker).  Failures AND hedge-slow events feed one time-decayed
+    score; crossing ``fail_threshold`` opens the circuit and the
+    replica leaves routing immediately — a gray (slow-not-dead) replica
+    is evicted within a few hedge delays instead of waiting out 3
+    health-probe periods.  After ``cooldown_s`` the breaker goes
+    half-open: exactly ONE probe request is let through; its success
+    closes the breaker, its failure re-opens it.
+
+    The clock is injectable so the state machine unit-tests run
+    sleep-free."""
+
+    __slots__ = ("fail_threshold", "decay_s", "cooldown_s", "clock",
+                 "score", "scored_at", "state", "opened_at", "probing",
+                 "probe_since")
+
+    def __init__(self, fail_threshold: Optional[float] = None,
+                 decay_s: Optional[float] = None,
+                 cooldown_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        from ray_tpu._private.config import config
+
+        self.fail_threshold = float(
+            fail_threshold if fail_threshold is not None
+            else config.serve_circuit_fail_threshold)
+        self.decay_s = float(decay_s if decay_s is not None
+                             else config.serve_circuit_decay_s)
+        self.cooldown_s = float(cooldown_s if cooldown_s is not None
+                                else config.serve_circuit_cooldown_s)
+        self.clock = clock
+        self.score = 0.0
+        self.scored_at = self.clock()
+        self.state = "closed"
+        self.opened_at = 0.0
+        self.probing = False
+        self.probe_since = 0.0
+
+    def _decayed(self, now: float) -> float:
+        # exponential half-life decay: one old burst of failures stops
+        # mattering within a few decay windows with no bookkeeping
+        age = max(0.0, now - self.scored_at)
+        return self.score * (0.5 ** (age / self.decay_s))
+
+    def record_failure(self, weight: float = 1.0) -> bool:
+        """An error, timeout, or hedge-slow event against this replica.
+        Returns True when this event OPENED the circuit (callers count
+        ray_tpu_serve_circuit_open_total on the transition)."""
+        now = self.clock()
+        self.score = self._decayed(now) + weight
+        self.scored_at = now
+        if self.state == "half_open":
+            # the probe failed: straight back to open, fresh cooldown
+            self.state = "open"
+            self.opened_at = now
+            self.probing = False
+            return False
+        if self.state == "closed" and self.score >= self.fail_threshold:
+            self.state = "open"
+            self.opened_at = now
+            self.probing = False
+            return True
+        return False
+
+    def record_success(self) -> None:
+        now = self.clock()
+        if self.state == "half_open":
+            self.state = "closed"
+            self.score = 0.0
+            self.scored_at = now
+            self.probing = False
+            return
+        # successes actively pay DOWN the score (on top of time decay):
+        # a mostly-healthy replica serving real traffic can never
+        # accumulate its way to the threshold from the tail-rate slow
+        # events p99 hedging produces by construction — only a replica
+        # whose failures/slowness OUTPACE its successes opens
+        self.score = max(0.0, self._decayed(now) - 0.5)
+        self.scored_at = now
+
+    def routable(self) -> bool:
+        """May a request be routed to this replica right now?  Open →
+        no; past the cooldown the breaker turns half-open and admits
+        requests only while no probe is in flight.  Non-consuming: the
+        picker calls ``note_picked`` on the replica it actually chose."""
+        if self.state == "closed":
+            return True
+        now = self.clock()
+        if self.state == "open":
+            if now - self.opened_at < self.cooldown_s:
+                return False
+            self.state = "half_open"
+            self.probing = False
+        if self.probing and self.probe_since \
+                and now - self.probe_since > max(2 * self.cooldown_s, 5.0):
+            # stale probe: its outcome was never recorded (the probe
+            # request was a stream, or a cancelled hedge loser) — a
+            # lost probe must not wedge the replica out of routing
+            # forever
+            self.probing = False
+        return not self.probing
+
+    def note_picked(self) -> None:
+        """The router chose this replica; a half-open breaker marks its
+        single probe in flight (cleared by the probe's outcome, or by
+        the stale-probe expiry in ``routable``)."""
+        if self.state == "half_open":
+            self.probing = True
+            self.probe_since = self.clock()
+
+    def allow(self) -> bool:
+        """Convenience for tests/direct users: routable-and-picked in
+        one step (exactly one half-open probe gets True)."""
+        if not self.routable():
+            return False
+        self.note_picked()
+        return True
+
+
 class DeploymentHandle:
     """Client-side router: least-outstanding-requests replica choice
     (reference: router.py assign_request + pow_2_scheduler.py), with
@@ -1078,8 +1231,10 @@ class DeploymentHandle:
 
     def __init__(self, name: str, replica_ids: List[str], version: int = 0,
                  replica_nodes: Optional[List[str]] = None,
-                 max_ongoing: int = 8):
+                 max_ongoing: int = 8,
+                 policy: Optional[Dict[str, Any]] = None):
         import uuid
+        from collections import deque
 
         from ray_tpu._private.worker import global_worker_or_none
 
@@ -1088,6 +1243,18 @@ class DeploymentHandle:
         self._lock = threading.Lock()
         self._version = version
         self._max_ongoing = max_ongoing
+        # tail-tolerance policy (Deployment.policy(): request_timeout_s,
+        # hedge_after_s | "p99", idempotent) — learned from the
+        # controller, refreshed with the roster
+        self._policy: Dict[str, Any] = dict(policy or {})
+        # per-replica circuit breakers + a windowed latency sample ring
+        # (the hedge_after="p99" source; the computed p99 is cached and
+        # refreshed every ~20 samples — sorting 200 floats under the
+        # handle lock per request would be hot-path waste)
+        self._circuits: Dict[str, ReplicaCircuit] = {}
+        self._latencies: "deque" = deque(maxlen=200)
+        self._lat_version = 0
+        self._p99_cache: Optional[tuple] = None  # (version, value)
         w = global_worker_or_none()
         self._my_node = w.node_id if w is not None else ""
         self._set_replicas(replica_ids, replica_nodes)
@@ -1104,6 +1271,65 @@ class DeploymentHandle:
         with self._lock:
             self._sheds_pending += 1
 
+    # ---- tail tolerance ---------------------------------------------------
+
+    def _circuit(self, rid: str) -> ReplicaCircuit:
+        c = self._circuits.get(rid)
+        if c is None:
+            c = self._circuits.setdefault(rid, ReplicaCircuit())
+        return c
+
+    def _record_outcome(self, rid: str, latency_s: Optional[float] = None,
+                        error: bool = False, slow: bool = False) -> None:
+        """Feed one request outcome into the replica's breaker (and the
+        handle's latency window).  A breaker OPEN transition counts in
+        ray_tpu_serve_circuit_open_total — the moment a gray replica
+        leaves routing."""
+        c = self._circuit(rid)
+        if error or slow:
+            if c.record_failure():
+                try:
+                    from ray_tpu._private.metrics import serve_tail_metrics
+
+                    serve_tail_metrics()[1].inc(
+                        tags={"deployment": self._name})
+                except Exception:
+                    pass
+        else:
+            c.record_success()
+            if latency_s is not None:
+                with self._lock:
+                    self._latencies.append(latency_s)
+                    self._lat_version += 1
+
+    def _hedge_delay(self) -> Optional[float]:
+        """Seconds to wait before firing a duplicate request, or None
+        when hedging is off for this deployment.  Hedging requires the
+        deployment to be declared idempotent — a duplicate of a
+        non-idempotent request could double-apply side effects."""
+        pol = self._policy
+        h = pol.get("hedge_after_s")
+        if h is None or not pol.get("idempotent"):
+            return None
+        if isinstance(h, (int, float)):
+            return max(0.0, float(h))
+        # "p99": track the observed distribution; until enough samples
+        # exist, hedge at the configured floor
+        from ray_tpu._private.config import config
+
+        floor = float(config.serve_hedge_min_delay_s)
+        with self._lock:
+            cached = self._p99_cache
+            if cached is not None and self._lat_version - cached[0] < 20:
+                return max(floor, cached[1])
+            samples = sorted(self._latencies)
+            if len(samples) < 10:
+                return floor
+            p99 = samples[min(len(samples) - 1,
+                              int(0.99 * len(samples)))]
+            self._p99_cache = (self._lat_version, p99)
+        return max(floor, p99)
+
     def _set_replicas(self, replica_ids: List[str],
                       replica_nodes: Optional[List[str]] = None):
         from ray_tpu.api import ActorHandle
@@ -1115,6 +1341,10 @@ class DeploymentHandle:
         # instead of corrupting a rebuilt positional array
         old = getattr(self, "_inflight", {})
         self._inflight = {rid: old.get(rid, 0) for rid in replica_ids}
+        # breakers for replicas no longer in the roster are dropped (a
+        # replaced replica's id never comes back)
+        self._circuits = {rid: c for rid, c in self._circuits.items()
+                          if rid in self._inflight}
 
     def _maybe_refresh(self, force: bool = False):
         now = time.monotonic()
@@ -1172,15 +1402,22 @@ class DeploymentHandle:
                 self._version = info["version"]
                 self._max_ongoing = info.get("max_ongoing",
                                              self._max_ongoing)
+                if info.get("policy") is not None:
+                    self._policy = dict(info["policy"])
                 self._set_replicas(info["replica_ids"],
                                    info.get("replica_nodes"))
 
-    def _pick_replica(self, local_pref: bool = True, exclude=None):
+    def _pick_replica(self, local_pref: bool = True, exclude=None,
+                      probe: bool = False):
         """Choose a replica (least-outstanding-requests) and charge it
         +1 inflight; returns (replica, rid).  ``exclude`` filters out
         replicas a retrying caller already saw die — unless that would
         leave nothing, in which case every replica is fair game again
-        (the exclusion list may be stale across a re-heal)."""
+        (the exclusion list may be stale across a re-heal).  ``probe``
+        marks a half-open pick as the breaker's single probe — only
+        callers that RECORD outcomes (call_async) pass it; a stream
+        pick must not consume the probe slot its outcome would never
+        release."""
         import random
 
         with self._lock:
@@ -1192,6 +1429,15 @@ class DeploymentHandle:
                 alive = [r for r in candidates
                          if r._actor_id not in exclude]
                 candidates = alive or candidates
+            # circuit-broken replicas leave routing (open breaker) until
+            # their half-open probe re-admits them; if EVERY candidate
+            # is broken, routing falls back to all of them — degraded
+            # service beats refusing to route at all
+            if self._circuits:
+                healthy = [r for r in candidates
+                           if (c := self._circuits.get(r._actor_id))
+                           is None or c.routable()]
+                candidates = healthy or candidates
             # locality-aware power-of-two (reference:
             # pow_2_scheduler.py:717): prefer same-node replicas only
             # while they have queue headroom — a saturated local replica
@@ -1210,6 +1456,9 @@ class DeploymentHandle:
                           key=lambda r: self._inflight.get(r._actor_id, 0))
             rid = replica._actor_id
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
+            c = self._circuits.get(rid)
+            if probe and c is not None:
+                c.note_picked()  # a half-open pick is THE probe
         return replica, rid
 
     def _submit_call(self, replica, rid: str, _method: str, args, kwargs):
@@ -1279,25 +1528,53 @@ class DeploymentHandle:
         surfacing ActorDiedError to the client — graceful degradation
         under churn.  User exceptions (RayTaskError) are NEVER retried;
         only replica-death errors are, ``serve_dead_replica_retries``
-        times, with a forced controller refresh between attempts."""
-        import ray_tpu
+        times, with a forced controller refresh between attempts.
+
+        Tail tolerance rides here too: the deployment's
+        ``request_timeout_s`` caps the budget (combined with any
+        ambient deadline — an X-Request-Deadline-Ms ingress header —
+        and stamped into the replica task so the downstream tree
+        inherits it), IDEMPOTENT deployments hedge a duplicate request
+        to a second replica after the hedge delay (first response
+        wins, the loser is cancelled), and every outcome feeds the
+        per-replica circuit breaker."""
+        from ray_tpu._private import deadlines
         from ray_tpu._private.config import config
         from ray_tpu._private.errors import (ActorDiedError,
                                              ActorUnavailableError,
+                                             DeadlineExceededError,
                                              RayWorkerError)
 
         await self._refresh_async()
         if not self._replicas:
             await self._refresh_async(force=True)
+        rt = self._policy.get("request_timeout_s")
+        policy_bound = rt is not None and float(rt) < _timeout
+        if policy_bound:
+            _timeout = float(rt)
+        ambient = deadlines.current_deadline()
+        # only a REAL bound (policy or ambient header deadline) stamps a
+        # deadline into the replica task — the transport's 120s default
+        # must not arm the deadline sweep for every unbounded request,
+        # shorten a client's explicit (longer) header deadline, or
+        # convert a long-running request into a 504
+        if policy_bound:
+            deadline = deadlines.effective_deadline(_timeout)
+        else:
+            deadline = ambient  # None when truly unbounded
+        bounded = policy_bound or ambient is not None
         attempts = 1 + max(0, int(config.serve_dead_replica_retries))
         dead: set = set()
         for attempt in range(attempts):
             if not self._replicas:
                 await self._refresh_async(force=True)
-            replica, rid = self._pick_replica(exclude=dead)
-            ref = self._submit_call(replica, rid, _method, args, kwargs)
+            replica, rid = self._pick_replica(exclude=dead, probe=True)
             try:
-                return await ray_tpu.get_async(ref, timeout=_timeout)
+                return await self._await_call(replica, rid, _method, args,
+                                              kwargs, deadline, bounded,
+                                              dead, _timeout, policy_bound)
+            except DeadlineExceededError:
+                raise  # the budget is gone; retrying cannot help
             except (ActorDiedError, ActorUnavailableError,
                     RayWorkerError):
                 dead.add(rid)
@@ -1307,6 +1584,135 @@ class DeploymentHandle:
                 # the controller may have re-healed already; otherwise
                 # surviving cached replicas keep serving
                 await self._refresh_async(force=True)
+
+    async def _await_call(self, replica, rid: str, _method: str, args,
+                          kwargs, deadline: Optional[float],
+                          bounded: bool, dead: set,
+                          _timeout: float = 120.0,
+                          policy_bound: bool = False):
+        """One submit-and-await attempt, with hedging.  The replica
+        task is submitted under the active deadline (so the spec
+        carries it); if the primary has not answered after the hedge
+        delay, a duplicate fires against a second replica — first
+        response wins and the loser is cancelled through the task
+        cancel machinery.  Outcomes (latency, errors, hedge-slowness)
+        feed the per-replica circuit breakers."""
+        import asyncio
+
+        import ray_tpu
+        from ray_tpu._private import deadlines
+        from ray_tpu._private.errors import (DeadlineExceededError,
+                                             GetTimeoutError, RayTaskError)
+
+        def _budget() -> float:
+            rem = deadlines.remaining(deadline)
+            return _timeout if rem is None else rem
+
+        def _submit(rep, rep_id):
+            token = deadlines.activate(deadline) if deadline else None
+            try:
+                return self._submit_call(rep, rep_id, _method, args, kwargs)
+            finally:
+                if token is not None:
+                    deadlines.restore(token)
+
+        async def _one(ref, rep_id, t_start):
+            try:
+                out = await ray_tpu.get_async(ref, timeout=_budget())
+            except GetTimeoutError:
+                # a miss of the DEPLOYMENT's own SLO is a replica-health
+                # signal; an expiry of the CLIENT's (possibly
+                # impossibly-tight) header budget is not — feeding the
+                # latter to the breaker would open circuits on healthy
+                # replicas whenever an upstream sends doomed budgets
+                if policy_bound:
+                    self._record_outcome(rep_id, error=True)
+                if bounded:
+                    deadlines.count_exceeded("get")
+                    raise DeadlineExceededError(
+                        f"deployment {self._name!r} request exceeded its "
+                        f"deadline", where="get") from None
+                raise
+            except RayTaskError:
+                raise  # application error: not a replica-health signal
+            except ray_tpu.RayError:
+                self._record_outcome(rep_id, error=True)
+                raise
+            self._record_outcome(rep_id,
+                                 latency_s=time.monotonic() - t_start)
+            return out
+
+        hedge_delay = self._hedge_delay()
+        t0 = time.monotonic()
+        ref = _submit(replica, rid)
+        primary = asyncio.ensure_future(_one(ref, rid, t0))
+        if hedge_delay is None:
+            return await primary
+        done, _ = await asyncio.wait({primary}, timeout=hedge_delay)
+        if done:
+            return primary.result()  # answered before the hedge delay
+        try:
+            h_replica, h_rid = self._pick_replica(
+                exclude={rid} | set(dead), probe=True)
+        except RuntimeError:
+            return await primary  # nowhere to hedge to
+        if h_rid == rid:
+            # exclusion exhausted (single live replica): nothing was
+            # submitted for this pick — release its inflight charge or
+            # every bailed hedge would inflate the count forever
+            with self._lock:
+                if rid in self._inflight:
+                    self._inflight[rid] -= 1
+            return await primary
+        from ray_tpu._private.metrics import serve_tail_metrics
+
+        hedges = serve_tail_metrics()[0]
+        h_ref = _submit(h_replica, h_rid)
+        hedge = asyncio.ensure_future(_one(h_ref, h_rid,
+                                           time.monotonic()))
+        tasks = {primary: (ref, rid), hedge: (h_ref, h_rid)}
+        pending = set(tasks)
+        first_error = None
+        try:
+            while pending:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED)
+                for t in done:
+                    if t.exception() is None:
+                        if t is hedge:
+                            # the duplicate beat the primary: THAT is
+                            # the gray-replica breaker signal (a p99
+                            # hedge against a healthy primary usually
+                            # loses the race, so healthy replicas
+                            # don't accumulate slow events at the
+                            # hedge-fire rate)
+                            self._record_outcome(rid, slow=True)
+                            hedges.inc(tags={"outcome": "won"})
+                        else:
+                            hedges.inc(tags={"outcome": "lost"})
+                        return t.result()
+                    if first_error is None or t is primary:
+                        first_error = t.exception()
+            raise first_error
+        finally:
+            # cancel the loser: its replica must stop working on a
+            # request nobody will read (same machinery as client-
+            # disconnect generator cancel)
+            for t, (loser_ref, _loser_rid) in tasks.items():
+                if not t.done():
+                    t.cancel()
+                    try:
+                        from ray_tpu._private.ids import ObjectID
+                        from ray_tpu._private.worker import \
+                            global_worker_or_none
+
+                        w = global_worker_or_none()
+                        if w is not None:
+                            tid = ObjectID(bytes.fromhex(
+                                loser_ref.oid)).task_id().hex()
+                            w._spawn(w._cancel_async(tid, False))
+                    except Exception:
+                        pass
 
     def _submit_stream(self, replica, rid: str, _method: str, args, kwargs):
         """Submit one streaming replica call; returns (gen, release)."""
@@ -1467,7 +1873,8 @@ def run(app: Application, name: Optional[str] = None) -> DeploymentHandle:
             dep_name, cloudpickle.dumps(d.func_or_class), num_replicas,
             d.max_ongoing_requests, d.init_args, d.init_kwargs,
             d.ray_actor_options, autoscaling,
-            float(config.serve_replica_health_timeout_s), d.llm),
+            float(config.serve_replica_health_timeout_s), d.llm,
+            d.policy()),
             timeout=float(config.serve_replica_health_timeout_s) + 120.0)
     except ray_tpu.RayTaskError as e:
         if isinstance(e.cause, DeploymentFailedError):
@@ -1496,7 +1903,8 @@ def get_handle(name: str, timeout: float = 30.0) -> DeploymentHandle:
         raise ValueError(f"no deployment named {name!r}")
     return DeploymentHandle(name, info["replica_ids"], info["version"],
                             info.get("replica_nodes"),
-                            max_ongoing=info.get("max_ongoing", 8))
+                            max_ongoing=info.get("max_ongoing", 8),
+                            policy=info.get("policy"))
 
 
 def delete(name: str):

@@ -50,11 +50,22 @@ def _is_overload_error(e) -> bool:
     riding inside a RayTaskError chain — matched structurally so the
     proxy can answer 503 without importing the llm module on the hot
     path."""
+    return _chain_has(e, "LLMOverloadedError")
+
+
+def _is_deadline_error(e) -> bool:
+    """DeadlineExceededError — raised proxy-side by a bounded await, or
+    replica-side (LLM admission, a bounded nested get) and carried in a
+    RayTaskError chain.  Mapped to 504 Gateway Timeout: the budget is
+    spent, retrying the same request cannot help."""
+    return _chain_has(e, "DeadlineExceededError")
+
+
+def _chain_has(e, name: str) -> bool:
     seen = set()
     while e is not None and id(e) not in seen:
         seen.add(id(e))
-        if type(e).__name__ == "LLMOverloadedError" \
-                or "LLMOverloadedError" in str(e):
+        if type(e).__name__ == name or name in str(e):
             return True
         e = getattr(e, "cause", None) or e.__cause__
     return False
@@ -429,6 +440,15 @@ class _HttpProxy:
             f"http {method} {path}", kind=tracing.KIND_SERVER,
             parent=tracing.parse_traceparent(headers.get("traceparent")))
         token = tracing.activate(span.context()) if span else None
+        # an absolute X-Request-Deadline-Ms header becomes the ambient
+        # deadline for this request's whole coroutine tree: the handle
+        # call stamps it into the replica task spec, so every nested
+        # .remote()/get() downstream spends only the caller's remaining
+        # budget (deadlines.py — the W3C-traceparent of latency bounds)
+        from ray_tpu._private import deadlines
+
+        dl = deadlines.from_header(headers.get(deadlines.DEADLINE_HEADER))
+        dl_token = deadlines.activate(dl) if dl is not None else None
         try:
             status, payload, stream = await self._route_inner(
                 method, target, headers, body)
@@ -444,6 +464,8 @@ class _HttpProxy:
                 stream = self._gated_stream(stream, _GateCharge(self))
             else:
                 self._inflight -= 1
+            if dl_token is not None:
+                deadlines.restore(dl_token)
             if token is not None:
                 tracing.restore(token)
         self._latency.observe(time.perf_counter() - t0,
@@ -536,6 +558,13 @@ class _HttpProxy:
                         return ("503 Service Unavailable", json.dumps(
                             {"error": f"{type(e).__name__}: {e}"}).encode(),
                             None)
+                    if _is_deadline_error(e):
+                        # budget gone before the first item (LLM
+                        # admission refusal, expired while queued): a
+                        # real status line, not an error chunk
+                        return ("504 Gateway Timeout", json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}).encode(),
+                            None)
                     raise
                 return "200 OK", b"", self._chain_first(first, gen)
             result = await self._call_async(path, arg)
@@ -549,6 +578,11 @@ class _HttpProxy:
                 self._sheds.inc(tags={"reason": "replica"})
                 self._note_shed(path)
                 return "503 Service Unavailable", json.dumps(
+                    {"error": f"{type(e).__name__}: {e}"}).encode(), None
+            if _is_deadline_error(e):
+                # the request's end-to-end deadline expired inside the
+                # cluster: 504, the budget is spent
+                return "504 Gateway Timeout", json.dumps(
                     {"error": f"{type(e).__name__}: {e}"}).encode(), None
             return "500 Internal Server Error", json.dumps(
                 {"error": f"{type(e).__name__}: {e}"}).encode(), None
@@ -584,11 +618,15 @@ class _HttpProxy:
         refreshing the handle once, like the sync path always did."""
         import ray_tpu
 
+        from ray_tpu._private.errors import DeadlineExceededError
+
         handle = await self._resolve_handle_async(name)
         try:
             return await handle.call_async(arg, _timeout=120)
         except ray_tpu.RayTaskError:
             raise  # user exception: retrying cannot change the outcome
+        except DeadlineExceededError:
+            raise  # budget spent: a retry would just spend more
         except ray_tpu.RayError:
             handle = await self._resolve_handle_async(name, fresh=True)
             return await handle.call_async(arg, _timeout=120)
@@ -623,6 +661,7 @@ class _HttpProxy:
             from ray_tpu._private.config import config
             from ray_tpu._private.errors import (ActorDiedError,
                                                  ActorUnavailableError,
+                                                 DeadlineExceededError,
                                                  RayWorkerError)
 
             dead_errors = (ActorDiedError, ActorUnavailableError,
@@ -649,6 +688,11 @@ class _HttpProxy:
                         value = await ray_tpu.get_async(ref, timeout=120)
                     except ray_tpu.RayTaskError:
                         raise  # user/application error: never retried
+                    except DeadlineExceededError:
+                        # the stream's budget expired mid-decode: close
+                        # with the typed error chunk (the chunk writer's
+                        # producer-error path), never resume-retry
+                        raise
                     except ray_tpu.RayError as e:
                         retries += 1
                         if isinstance(e, dead_errors) and info.get("rid"):

@@ -20,8 +20,11 @@ Request contract (token-level; tokenization is the client's concern):
    "eos": int | None,           # optional stop token
    "request_id": str | None,    # idempotency key: a retried request
                                 # re-attaches to the live sequence
-   "emit_from": int | None}     # first generation index to emit —
+   "emit_from": int | None,     # first generation index to emit —
                                 # the resume cursor for proxy retries
+   "deadline_ms": float | None} # absolute epoch-ms deadline; combined
+                                # (tighter wins) with the ambient task
+                                # deadline / X-Request-Deadline-Ms
 Each streamed item is {"i": <first generation index>, "tokens":
 [<id>, ...], "done": <bool>} — items COALESCE every token generated
 since the consumer last drained (the decode loop outruns the per-item
@@ -44,6 +47,9 @@ import threading
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import deadlines
+from ray_tpu._private.errors import DeadlineExceededError
 
 __all__ = ["LLMEngine", "LLMOverloadedError", "llm_deployment",
            "run_llm_loop"]
@@ -119,7 +125,7 @@ class _Seq:
                  "max_new", "eos", "block_table", "pos", "state", "done",
                  "error", "attach_count", "detached_at", "done_at",
                  "submitted_at", "first_token_at", "cancelled",
-                 "slot_cache", "cond")
+                 "slot_cache", "cond", "deadline")
 
     def __init__(self, request_id: str, prompt: List[int], max_new: int,
                  eos: Optional[int], preknown: Optional[List[int]] = None):
@@ -148,6 +154,10 @@ class _Seq:
         self.submitted_at = time.monotonic()
         self.first_token_at: Optional[float] = None
         self.cancelled = False
+        # absolute wall-clock deadline (epoch seconds; 0 = unbounded):
+        # the sweep cancels expired in-flight sequences and recycles
+        # their pages instead of decoding for a caller that moved on
+        self.deadline = 0.0
 
     @property
     def total_len(self) -> int:
@@ -266,6 +276,12 @@ class LLMEngine:
         self._last_step_tokens = 0
         self._metrics = None
         self._warm = False
+        # EWMA of one engine step's wall time — the deadline-admission
+        # estimate of "prefill + one decode step" cost (0 until the
+        # first measured step; cold engines only refuse already-expired
+        # budgets)
+        self._step_ewma = 0.0
+        self._deadline_expired_total = 0
 
     # ------------------------------------------------------------ admission
 
@@ -284,6 +300,33 @@ class LLMEngine:
         eos = request.get("eos")
         eos = int(eos) if eos is not None else None
         rid = str(request.get("request_id") or uuid.uuid4().hex[:16])
+        # end-to-end deadline: the ambient context (stamped into the
+        # replica task by the handle / the X-Request-Deadline-Ms
+        # ingress header) combined with an explicit request-dict
+        # "deadline_ms" — tighter wins
+        dl = deadlines.effective_deadline() or 0.0
+        req_dl = deadlines.from_header(request.get("deadline_ms"))
+        if req_dl:
+            dl = min(dl, req_dl) if dl else req_dl
+        if dl:
+            rem = dl - time.time()
+            # admission refusal: a sequence whose remaining budget
+            # cannot cover its prefill + ONE decode step would only
+            # burn pages and batch lanes producing tokens its caller
+            # will never read.  Cost model: measured step EWMA x
+            # (prefill chunks + 1); a cold engine (no measured step
+            # yet) only refuses already-expired budgets.
+            need = 0.0
+            if self._step_ewma > 0.0:
+                chunks = -(-len(prompt) // self.prefill_chunk)
+                need = self._step_ewma * (chunks + 1)
+            if rem <= need:
+                self._deadline_expired_total += 1
+                deadlines.count_exceeded("admission")
+                raise DeadlineExceededError(
+                    f"remaining budget {max(rem, 0.0) * 1000:.0f}ms cannot "
+                    f"cover prefill + one decode step "
+                    f"(~{need * 1000:.0f}ms)", where="admission")
         with self._lock:
             seq = self._by_rid.get(rid)
             if seq is not None and seq.cancelled:
@@ -313,6 +356,7 @@ class LLMEngine:
                 raise LLMOverloadedError(
                     f"admission queue full ({self.max_queue})")
             seq = _Seq(rid, prompt, max_new, eos)
+            seq.deadline = dl
             seq.cond = threading.Condition(self._lock)
             seq.attach_count = 1
             self._by_rid[rid] = seq
@@ -431,11 +475,27 @@ class LLMEngine:
                 + pos % self.page_size)
 
     def _sweep(self, now: float) -> None:
-        """Lock held: cancel sequences abandoned past the grace window
-        and forget finished ones past the replay TTL."""
+        """Lock held: expire sequences past their deadline (pages
+        recycle NOW; the consumer sees the typed error), cancel
+        sequences abandoned past the grace window, and forget finished
+        ones past the replay TTL."""
         from ray_tpu._private.config import config
 
+        wall = time.time()
         for seq in list(self._active) + list(self._queued):
+            if seq.deadline and wall >= seq.deadline and not seq.done:
+                self._deadline_expired_total += 1
+                # a sequence still parked at admission expired WAITING,
+                # not decoding — the queued/running split is the signal
+                # operators act on (shed earlier vs loosen budgets)
+                where = "queued" if seq.state == _QUEUED else "running"
+                deadlines.count_exceeded(where)
+                seq.error = DeadlineExceededError(
+                    f"sequence {seq.request_id} exceeded its deadline "
+                    f"while {where} ({len(seq.generated)}/{seq.max_new} "
+                    f"tokens generated)", where=where)
+                self._finish_seq(seq, cancelled=True)
+                continue
             if (seq.attach_count == 0 and seq.detached_at is not None
                     and now - seq.detached_at > self.detach_grace_s):
                 self._finish_seq(seq, cancelled=True)
@@ -490,6 +550,7 @@ class LLMEngine:
         nothing to do (the loop then parks on the condition)."""
         np = self._np
         now = time.monotonic()
+        t_step = time.perf_counter()
         with self._lock:
             self._sweep(now)
             self._admit_locked()
@@ -591,6 +652,19 @@ class LLMEngine:
         self._steps += 1
         self._last_batch = len(decode_args)
         self._last_step_tokens = step_tokens
+        # step-cost estimate for deadline admission (prefill + one
+        # decode step).  Admission wants "can this POSSIBLY finish", so
+        # the estimate must be a floor-ish typical cost: a faster step
+        # pulls it down immediately (the first post-compile step erases
+        # the multi-second jit-compile sample), and slow outliers (a GC
+        # pause, a compile for a new shape) are clamped so one huge
+        # step cannot poison the estimate into shedding healthy traffic
+        dt = time.perf_counter() - t_step
+        if self._step_ewma == 0.0 or dt < self._step_ewma:
+            self._step_ewma = dt
+        else:
+            self._step_ewma = 0.9 * self._step_ewma \
+                + 0.1 * min(dt, 5.0 * self._step_ewma)
         self._set_gauges()
         return True
 
@@ -687,6 +761,7 @@ class LLMEngine:
                     "queued": len(self._queued),
                     "active": len(self._active),
                     "cancelled": self._cancelled_total,
+                    "deadline_expired": self._deadline_expired_total,
                     "live_seqs": len(self._by_rid),
                     "free_pages": len(self._free_pages),
                     "used_pages": self.num_pages - 1 - len(self._free_pages),
@@ -838,6 +913,8 @@ def llm_deployment(name: str = "llm", *, num_replicas: Any = 1,
                    max_ongoing_requests: int = 64,
                    ray_actor_options: Optional[Dict[str, Any]] = None,
                    autoscaling_config: Optional[Dict[str, Any]] = None,
+                   request_timeout_s: Optional[float] = None,
+                   hedge_after_s: Any = None, idempotent: bool = False,
                    **engine_kwargs):
     """Build an LLM serving Application: replicas host an
     :class:`LLMEngine` and the controller installs the pinned decode
@@ -859,5 +936,6 @@ def llm_deployment(name: str = "llm", *, num_replicas: Any = 1,
                    ray_actor_options=dict(ray_actor_options or {}),
                    autoscaling_config=dict(autoscaling_config)
                    if autoscaling_config else None,
-                   llm=True)
+                   llm=True, request_timeout_s=request_timeout_s,
+                   hedge_after_s=hedge_after_s, idempotent=idempotent)
     return d.bind(**engine_kwargs)

@@ -100,6 +100,86 @@ def resource_leak_tripwire(request):
             f"component is surviving shutdown()")
 
 
+# -------------------------------------------- module wall-clock tripwire
+# Tier-1 runs against an 870s wall budget that is nearly full; this
+# makes budget pressure visible per PR instead of discovered as a suite
+# timeout.  Every run prints a per-module duration table in the pytest
+# terminal summary; any single FAST module (its non-slow tests only)
+# above _MODULE_BUDGET_S is flagged — informationally under tier-1
+# (-m 'not slow'), as a session FAILURE otherwise, so full runs catch
+# the regression before the tier-1 driver run hits the wall.
+
+_MODULE_BUDGET_S = 45.0
+_MODULE_DURATIONS = {}  # module path -> accumulated fast-test seconds
+_SLOW_NODES = set()     # nodeids carrying @pytest.mark.slow
+
+
+def _module_budget_violations(durations, budget=_MODULE_BUDGET_S):
+    """[(module, seconds)] over budget, worst first.  Pure so the
+    tripwire itself is unit-testable."""
+    return sorted(((m, d) for m, d in durations.items() if d > budget),
+                  key=lambda kv: -kv[1])
+
+
+def _is_tier1(config) -> bool:
+    # the tier-1 invocation deselects slow tests via -m 'not slow'
+    return "not slow" in (getattr(config.option, "markexpr", "") or "")
+
+
+def pytest_collection_modifyitems(config, items):
+    for it in items:
+        if it.get_closest_marker("slow"):
+            _SLOW_NODES.add(it.nodeid)
+
+
+def pytest_runtest_logreport(report):
+    if report.when not in ("setup", "call", "teardown"):
+        return
+    if report.nodeid in _SLOW_NODES:
+        return  # slow tests have their own (non-tier-1) time budget
+    mod = report.nodeid.split("::", 1)[0]
+    _MODULE_DURATIONS[mod] = (_MODULE_DURATIONS.get(mod, 0.0)
+                              + (report.duration or 0.0))
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    if not _MODULE_DURATIONS:
+        return
+    tr = terminalreporter
+    ranked = sorted(_MODULE_DURATIONS.items(), key=lambda kv: -kv[1])
+    tr.section(f"per-module wall clock (fast tests; budget "
+               f"{_MODULE_BUDGET_S:.0f}s/module)")
+    for mod, d in ranked[:15]:
+        flag = "  << OVER BUDGET" if d > _MODULE_BUDGET_S else ""
+        tr.write_line(f"{d:8.1f}s  {mod}{flag}")
+    total = sum(_MODULE_DURATIONS.values())
+    tr.write_line(f"{total:8.1f}s  TOTAL (tier-1 wall budget: 870s)")
+    over = _module_budget_violations(_MODULE_DURATIONS)
+    if over:
+        names = ", ".join(f"{m} ({d:.0f}s)" for m, d in over)
+        if _is_tier1(config):
+            tr.write_line(
+                f"WARNING: fast module(s) over the {_MODULE_BUDGET_S:.0f}s "
+                f"budget: {names} — move tests behind @pytest.mark.slow "
+                f"or speed them up before the tier-1 suite hits its "
+                f"870s wall")
+        else:
+            tr.write_line(
+                f"ERROR: fast module(s) over the {_MODULE_BUDGET_S:.0f}s "
+                f"budget: {names} (failing the session; informational "
+                f"under -m 'not slow')")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # the tripwire FAILS full (non-tier-1) runs so budget regressions
+    # surface locally; under tier-1 it stays informational — the tier-1
+    # driver run must never be failed retroactively by a watchdog
+    if exitstatus != 0 or _is_tier1(session.config):
+        return
+    if _module_budget_violations(_MODULE_DURATIONS):
+        session.exitstatus = 1
+
+
 def force_cpu_jax():
     """In-process override: this interpreter may already have the TPU
     plugin registered (sitecustomize); select CPU before first use."""

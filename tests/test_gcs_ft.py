@@ -125,3 +125,45 @@ def test_agents_reregister_after_head_restart(restartable_cluster):
         time.sleep(0.25)
     raise AssertionError(
         f"cluster view did not recover: {ray_tpu.cluster_resources()}")
+
+
+@pytest.mark.slow
+def test_chaos_head_kill_agents_reregister(restartable_cluster):
+    """The ``head.kill`` chaos site (ISSUE 14 satellite): the PR-7
+    chaos engine can now exercise THIS module's recovery paths on
+    demand — the head SIGKILLs itself via `rtpu chaos`-style injection,
+    the supervisor restarts it on the same port, and agents re-register
+    with resources intact."""
+    restartable_cluster.add_node(num_cpus=2, resources={"extra": 1})
+    restartable_cluster.wait_for_nodes(2)
+    _wait_persist()
+    w = ray_tpu.api._worker()
+    st = w.head.call("chaos", op="inject",
+                     rule={"site": "head.kill", "action": "kill",
+                           "count": 1, "delay_s": 0.3}, timeout=30)
+    assert any(r["site"] == "head.kill" for r in st["rules"])
+    # the head self-SIGKILLs shortly after the reply flushed
+    assert restartable_cluster._head_proc.proc.wait(timeout=15) is not None
+    # same restart path the harness uses (kill on a dead pid is a no-op)
+    restartable_cluster.restart_head(kill=True)
+    deadline = time.monotonic() + 45
+    while time.monotonic() < deadline:
+        try:
+            res = ray_tpu.cluster_resources()
+            if res.get("CPU") == 6.0 and res.get("extra") == 1.0:
+                break
+        except Exception:
+            pass
+        time.sleep(0.25)
+    else:
+        raise AssertionError(
+            f"agents did not re-register after chaos head kill: "
+            f"{ray_tpu.cluster_resources()}")
+    # and the restarted head serves chaos status with a clean plane
+    w.head.call("chaos", op="clear", timeout=30)
+
+    @ray_tpu.remote
+    def probe():
+        return "ok"
+
+    assert ray_tpu.get(probe.remote(), timeout=60) == "ok"

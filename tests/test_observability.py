@@ -342,7 +342,9 @@ def test_metric_names_documented_in_readme(cluster):
                m.pipeline_metrics,
                m.llm_metrics,
                m.autoscaler_metrics,
-               m.serve_sheds_counter):
+               m.serve_sheds_counter,
+               m.deadline_metrics,
+               m.serve_tail_metrics):
         fn()
     with m.default_registry._lock:
         names |= set(m.default_registry._metrics)

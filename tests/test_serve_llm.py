@@ -256,6 +256,73 @@ def test_save_restore_resumes_generation():
     assert [t for _, t in flat] == s2.generated[k - 1:]
 
 
+def test_deadline_admission_refused():
+    """The fourth deadline-enforcement site: an engine refuses admission
+    when the remaining budget cannot cover prefill + one decode step —
+    typed DeadlineExceededError(where=admission), no pages touched."""
+    from ray_tpu._private import deadlines as dl
+    from ray_tpu._private.errors import DeadlineExceededError
+
+    eng = _engine()
+    # cold engine: only an already-expired budget refuses
+    token = dl.activate(time.time() - 0.5)
+    try:
+        with pytest.raises(DeadlineExceededError) as ei:
+            eng.submit({"tokens": [1, 2], "max_new_tokens": 4})
+    finally:
+        dl.restore(token)
+    assert ei.value.where == "admission"
+    # warmed engine: a budget smaller than (prefill chunks + 1) x the
+    # measured step EWMA refuses too — tokens that can't reach the
+    # caller in time must not burn pages/lanes
+    eng._step_ewma = 0.2  # 2 chunks + 1 decode = 0.6s needed
+    with pytest.raises(DeadlineExceededError):
+        eng.submit({"tokens": [1] * 16, "max_new_tokens": 4,
+                    "deadline_ms": (time.time() + 0.2) * 1000.0})
+    # a roomy budget admits normally
+    s = eng.submit({"tokens": [1, 2], "max_new_tokens": 2,
+                    "deadline_ms": (time.time() + 60.0) * 1000.0})
+    assert s.deadline > 0
+    _drain(eng)
+    assert eng.stats()["used_pages"] == 0
+    assert eng.stats()["deadline_expired"] >= 2
+
+
+def test_deadline_expiry_mid_decode_recycles_pages():
+    """An in-flight sequence past its deadline is cancelled by the
+    engine sweep: its consumer gets the typed error and its KV pages
+    return to the free pool (asserted via the ray_tpu_llm_kv_pages
+    gauge, not just stats)."""
+    from ray_tpu._private.errors import DeadlineExceededError
+    from ray_tpu._private.metrics import llm_metrics
+
+    eng = _engine()
+    pages_gauge = llm_metrics()[1]
+
+    def gauge(state):
+        for k, v in pages_gauge._values.items():
+            if ("state", state) in k:
+                return v
+        return None
+
+    eng._set_gauges()
+    free_baseline = gauge("free")
+    s = eng.submit({"tokens": [5, 9, 3], "max_new_tokens": 60,
+                    "deadline_ms": (time.time() + 0.15) * 1000.0})
+    for _ in range(3):
+        eng.step()
+    assert not s.done and eng.stats()["used_pages"] > 0
+    time.sleep(0.2)  # let the deadline pass
+    eng.step()  # sweep runs at step start
+    assert s.done and s.cancelled
+    assert isinstance(s.error, DeadlineExceededError)
+    assert s.error.where == "running"
+    with pytest.raises(DeadlineExceededError):
+        list(eng.iter_tokens(s, len(s.generated)))
+    assert eng.stats()["used_pages"] == 0
+    assert gauge("free") == free_baseline, "kv pages not back to baseline"
+
+
 def test_loop_single_flight_and_stop():
     eng = _engine()
     t = threading.Thread(target=eng.run_loop, daemon=True)
@@ -380,7 +447,7 @@ def llm_cluster():
         ray_tpu.shutdown()
 
 
-def _sse_request(host, port, name, payload, timeout=60):
+def _sse_request(host, port, name, payload, timeout=60, headers=None):
     """One streaming request over a raw socket; returns (status, items,
     sock, resp).  Caller closes sock (or uses _read_sse to drain)."""
     import http.client
@@ -388,7 +455,8 @@ def _sse_request(host, port, name, payload, timeout=60):
     conn = http.client.HTTPConnection(host, port, timeout=timeout)
     conn.request("POST", f"/{name}", body=json.dumps(payload),
                  headers={"Content-Type": "application/json",
-                          "Accept": "text/event-stream"})
+                          "Accept": "text/event-stream",
+                          **(headers or {})})
     resp = conn.getresponse()
     return conn, resp
 
@@ -477,20 +545,26 @@ def test_llm_queue_full_sheds_503(llm_cluster, llm_big):
     assert sum(len(it["tokens"]) for it in q_result["items"]) == 60
 
 
-def test_llm_disconnect_frees_kv_pages(llm_cluster):
+@pytest.fixture(scope="module")
+def llm_slow_steps(llm_cluster):
+    """A deliberately BIGGER model (~15-40ms/step vs ~2ms for the tiny
+    config) shared by the disconnect and deadline tests: both need the
+    decode to still be RUNNING when their trigger lands — the tiny
+    config's 240 tokens can finish before a disconnect RST or a
+    sub-second deadline is even noticed."""
+    return llm_cluster["deploy"]("llm_drop",
+                                 model=dict(MODEL, dim=192, n_layers=4,
+                                            hidden_dim=512,
+                                            max_seq_len=256),
+                                 num_pages=33, detach_grace_s=0.3)
+
+
+def test_llm_disconnect_frees_kv_pages(llm_cluster, llm_slow_steps):
     """Client vanishes mid-stream: the chunk writer's failure closes the
     stream chain, the handle cancels the replica-side generator, and
     the engine recycles the sequence's pages after the grace window —
-    instead of decoding another ~200 tokens for nobody.
-
-    Deliberately a BIGGER model than the rest of the module: the cancel
-    must land while the decode is still running (~15-40ms/step here vs
-    ~2ms for the tiny config, whose 240 tokens can finish before the
-    proxy's transport even notices the RST)."""
-    h = llm_cluster["deploy"]("llm_drop",
-                              model=dict(MODEL, dim=192, n_layers=4,
-                                         hidden_dim=512, max_seq_len=256),
-                              num_pages=33, detach_grace_s=0.3)
+    instead of decoding another ~200 tokens for nobody."""
+    h = llm_slow_steps
     before = ray_tpu.get(h.method("stats")(), timeout=30)
     conn, resp = _sse_request(llm_cluster["host"], llm_cluster["port"],
                               "llm_drop",
@@ -510,6 +584,53 @@ def test_llm_disconnect_frees_kv_pages(llm_cluster):
         time.sleep(0.1)
     assert st.get("cancelled", 0) > before["cancelled"] \
         and st.get("used_pages") == 0, (before, st)
+
+
+def test_llm_stream_deadline_expires_mid_decode(llm_cluster,
+                                                llm_slow_steps):
+    """Deadline-vs-stream interaction (ISSUE 14 satellite): an SSE
+    stream whose X-Request-Deadline-Ms budget expires mid-decode closes
+    with a TYPED error chunk (DeadlineExceededError, never a silent
+    truncation) AND the sequence's KV pages recycle back to baseline."""
+    h = llm_slow_steps
+    before = ray_tpu.get(h.method("stats")(), timeout=30)
+    # self-calibrating budget: decode speed varies box to box, so walk
+    # the budget down until the deadline bites mid-stream (a too-roomy
+    # budget lets the whole stream finish; that attempt just retries
+    # tighter).  TTFT is warm (<~50ms), so even the tightest budget
+    # still covers admission + first token.
+    token_items = err_items = None
+    for budget_s in (0.8, 0.4, 0.2, 0.1):
+        deadline_ms = (time.time() + budget_s) * 1000.0
+        conn, resp = _sse_request(
+            llm_cluster["host"], llm_cluster["port"], "llm_drop",
+            {"tokens": [5, 9, 3], "max_new_tokens": 240},
+            headers={"X-Request-Deadline-Ms": str(deadline_ms)})
+        assert resp.status == 200, \
+            f"budget {budget_s}s did not even cover TTFT"
+        items = _read_items(resp)
+        conn.close()
+        token_items = [it for it in items if "i" in it]
+        err_items = [it for it in items if "error" in it]
+        if sum(len(it["tokens"]) for it in token_items) < 240:
+            break  # the deadline bit mid-decode
+    assert token_items, "no tokens before the deadline"
+    assert sum(len(it["tokens"]) for it in token_items) < 240, \
+        "stream finished under every budget — deadline never bit"
+    assert err_items and "DeadlineExceededError" in err_items[-1]["error"], \
+        (items[-3:] if items else items)
+    # KV pages back to baseline (the engine expired the sequence and
+    # recycled; the free-page gauge is stats' source of truth)
+    deadline = time.time() + 60
+    st = {}
+    while time.time() < deadline:
+        st = ray_tpu.get(h.method("stats")(), timeout=30)
+        if st["used_pages"] == 0 \
+                and st["deadline_expired"] > before["deadline_expired"]:
+            break
+        time.sleep(0.1)
+    assert st.get("used_pages") == 0, st
+    assert st.get("deadline_expired", 0) > before["deadline_expired"], st
 
 
 @pytest.mark.slow

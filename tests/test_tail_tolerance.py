@@ -1,0 +1,287 @@
+"""Serve tail tolerance: hedged requests, per-replica circuit breakers,
+gray-failure chaos (ISSUE 14).
+
+Unit coverage for the ReplicaCircuit state machine (injectable clock,
+sleep-free) and the hedge-delay policy; e2e coverage for a 2-replica
+deployment with one GRAY (slow, not dead) replica — hedging absorbs it
+and the circuit breaker evicts it from routing — plus the chaos
+``worker.stall`` site that manufactures such replicas on demand.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.api import DeploymentHandle, ReplicaCircuit
+
+
+# ------------------------------------------------- circuit breaker units
+
+
+def _circuit(**kw):
+    now = [0.0]
+    kw.setdefault("fail_threshold", 3.0)
+    kw.setdefault("decay_s", 5.0)
+    kw.setdefault("cooldown_s", 1.0)
+    return ReplicaCircuit(clock=lambda: now[0], **kw), now
+
+
+def test_circuit_opens_after_threshold_and_probes_halfopen():
+    c, now = _circuit()
+    assert c.routable()
+    assert not c.record_failure()
+    assert not c.record_failure()
+    assert c.state == "closed" and c.routable()
+    assert c.record_failure() is True  # the opening transition
+    assert c.state == "open" and not c.routable()
+    now[0] = 0.5
+    assert not c.routable()  # still cooling down
+    now[0] = 1.5
+    assert c.routable() and c.state == "half_open"
+    c.note_picked()  # THE probe
+    assert not c.routable()  # only one probe in flight
+    c.record_success()
+    assert c.state == "closed" and c.routable() and c.score == 0.0
+
+
+def test_circuit_probe_failure_reopens():
+    c, now = _circuit()
+    for _ in range(3):
+        c.record_failure()
+    now[0] = 2.0
+    assert c.routable()
+    c.note_picked()
+    c.record_failure()  # probe failed
+    assert c.state == "open" and not c.routable()
+    now[0] = 2.5
+    assert not c.routable()  # fresh cooldown from the re-open
+    now[0] = 3.5
+    assert c.routable()
+
+
+def test_circuit_score_decays():
+    c, now = _circuit()
+    c.record_failure()
+    c.record_failure()
+    now[0] = 20.0  # 4 half-lives: the old burst is worth ~0.125
+    assert not c.record_failure()  # 1.125 < 3: stays closed
+    assert c.state == "closed"
+
+
+def test_allow_is_routable_plus_picked():
+    c, now = _circuit(fail_threshold=1.0)
+    c.record_failure()
+    now[0] = 1.5
+    assert c.allow() is True   # half-open probe consumed
+    assert c.allow() is False  # second caller refused
+
+
+# ------------------------------------------------------ hedge-delay unit
+
+
+def test_hedge_delay_policy(monkeypatch):
+    h = DeploymentHandle.__new__(DeploymentHandle)
+    import threading
+    from collections import deque
+
+    h._lock = threading.Lock()
+    h._latencies = deque(maxlen=200)
+    h._lat_version = 0
+    h._p99_cache = None
+    # no policy / not idempotent: hedging off
+    h._policy = {}
+    assert h._hedge_delay() is None
+    h._policy = {"hedge_after_s": 0.2}
+    assert h._hedge_delay() is None, "hedging requires idempotent=True"
+    h._policy = {"hedge_after_s": 0.2, "idempotent": True}
+    assert h._hedge_delay() == 0.2
+    # "p99": configured floor until enough samples, then the observed p99
+    h._policy = {"hedge_after_s": "p99", "idempotent": True}
+    from ray_tpu._private.config import config
+
+    assert h._hedge_delay() == float(config.serve_hedge_min_delay_s)
+    h._latencies.extend([0.01] * 99 + [0.5])
+    h._lat_version = 100
+    assert h._hedge_delay() == 0.5
+    # cached between samples: a heavier tail only shows up after the
+    # refresh window's worth of appends invalidates the cache
+    h._latencies.extend([0.9] * 5)
+    h._lat_version += 1
+    assert h._hedge_delay() == 0.5
+    h._lat_version += 20
+    assert h._hedge_delay() == 0.9
+
+
+# ------------------------------------------------------------------- e2e
+
+
+def _flaky_cls():
+    """Deployment target whose per-replica delay is settable directly
+    on the replica actor — the deterministic gray-replica knob.
+    Built in local scope so cloudpickle ships it by value (a module-
+    level test class would need this test module importable on the
+    replica workers)."""
+
+    class Flaky:
+        def __init__(self):
+            self.delay = 0.0
+
+        def __call__(self, x):
+            if self.delay:
+                time.sleep(self.delay)
+            return {"ok": 1}
+
+        def set_delay(self, d):
+            self.delay = float(d)
+            return True
+
+    return Flaky
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=96 * 1024 * 1024)
+    try:
+        yield ray_tpu
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+
+
+def _replica_actors(prefix):
+    w = ray_tpu.api._worker()
+    return [a for a in w.head.call("list_actors", timeout=30)["actors"]
+            if a.get("name", "").startswith(f"serve:{prefix}")
+            and a.get("state") == "ALIVE"]
+
+
+def test_hedging_and_circuit_evict_gray_replica(cluster, monkeypatch):
+    """One of two replicas goes gray (1.2s service time vs ~0).  A
+    request routed to it hedges a duplicate to the healthy replica
+    after hedge_after_s and the hedge WINS — p-high latency stays at
+    the hedge delay, zero failures — and the hedge-slow event opens the
+    gray replica's circuit so it leaves routing immediately."""
+    monkeypatch.setenv("RT_SERVE_CIRCUIT_FAIL_THRESHOLD", "1")
+    handle = serve.run(serve.deployment(
+        _flaky_cls(), name="hedged", num_replicas=2,
+        request_timeout_s=10.0, hedge_after_s=0.15,
+        idempotent=True).bind())
+    assert handle._policy["idempotent"] is True
+    # gray one replica
+    replicas = _replica_actors("hedged")
+    assert len(replicas) == 2
+    slow_name = replicas[0]["name"]
+    slow_rid = replicas[0]["actor_id"]
+    fast_rid = replicas[1]["actor_id"]
+    slow = ray_tpu.get_actor(slow_name)
+    assert ray_tpu.get(
+        slow.handle_request.remote("set_delay", (1.2,), {}), timeout=30)
+
+    from ray_tpu._private.metrics import serve_tail_metrics
+
+    hedges, circuit_opens = serve_tail_metrics()
+    won_before = sum(v for k, v in hedges._values.items()
+                     if ("outcome", "won") in k)
+    opens_before = sum(circuit_opens._values.values())
+
+    # force the first pick onto the gray replica (deterministically):
+    # pile phantom inflight on the healthy one
+    with handle._lock:
+        handle._inflight[fast_rid] = 50
+    t0 = time.monotonic()
+    out = asyncio.run(handle.call_async({"x": 1}))
+    dt = time.monotonic() - t0
+    with handle._lock:
+        handle._inflight[fast_rid] = 0
+    assert out == {"ok": 1}
+    assert dt < 1.0, f"hedge did not absorb the gray replica ({dt:.2f}s)"
+    won_after = sum(v for k, v in hedges._values.items()
+                    if ("outcome", "won") in k)
+    assert won_after > won_before, "hedge never fired/won"
+    # the hedge-slow event opened the gray replica's breaker
+    assert sum(circuit_opens._values.values()) > opens_before
+    c = handle._circuits.get(slow_rid)
+    assert c is not None and c.state in ("open", "half_open")
+
+    # with the circuit open the gray replica is out of routing: every
+    # subsequent request is fast WITHOUT needing a hedge
+    for _ in range(3):
+        t0 = time.monotonic()
+        assert asyncio.run(handle.call_async({"x": 2})) == {"ok": 1}
+        assert time.monotonic() - t0 < 1.0
+    serve.delete("hedged")
+
+
+def test_request_timeout_policy_bounds_unary_call(cluster):
+    """A deployment-level request_timeout_s bounds call_async: a wedged
+    replica surfaces the typed DeadlineExceededError at the budget, not
+    at the transport's 120s default."""
+    handle = serve.run(serve.deployment(
+        _flaky_cls(), name="bounded", num_replicas=1,
+        request_timeout_s=0.5).bind())
+    slow = ray_tpu.get_actor(_replica_actors("bounded")[0]["name"])
+    assert ray_tpu.get(
+        slow.handle_request.remote("set_delay", (10.0,), {}), timeout=30)
+    t0 = time.monotonic()
+    with pytest.raises(ray_tpu.DeadlineExceededError):
+        asyncio.run(handle.call_async({"x": 1}))
+    assert time.monotonic() - t0 < 3.0
+    serve.delete("bounded")
+
+
+def test_worker_stall_chaos_site(cluster):
+    """``worker.stall``: the target worker busy-hangs (gray) but never
+    dies — calls issued during the stall window complete late, the
+    process survives, and no restart happens.  Also proves head→agent
+    rule gossip end-to-end (the agent executes the gossiped rule)."""
+    from ray_tpu._private import fault_injection as fi
+
+    @ray_tpu.remote
+    class Probe:
+        def wid(self):
+            from ray_tpu._private.worker import global_worker_or_none
+
+            return global_worker_or_none().worker_id
+
+        def ping(self):
+            return "pong"
+
+    a = Probe.remote()
+    wid = ray_tpu.get(a.wid.remote(), timeout=60)
+    w = ray_tpu.api._worker()
+    w.head.call("chaos", op="inject",
+                rule={"site": "worker.stall", "action": "stall",
+                      "target": wid, "count": 1, "delay_s": 3.0},
+                timeout=30)
+    try:
+        # the rule reaches the agent by push (ms) or heartbeat catch-up
+        # (seconds, on a loaded box): keep pinging until one ping lands
+        # inside the stall window and visibly hangs
+        stalled = False
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            t0 = time.monotonic()
+            assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+            if time.monotonic() - t0 > 0.3:
+                stalled = True
+                break
+            time.sleep(0.05)
+        assert stalled, "worker never stalled (rule not applied?)"
+        # gray, not dead: same worker id (no restart), fast pings again
+        assert ray_tpu.get(a.wid.remote(), timeout=60) == wid
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            t0 = time.monotonic()
+            assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+            if time.monotonic() - t0 < 0.2:
+                return  # recovered
+        raise AssertionError("worker never recovered from the stall")
+    finally:
+        w.head.call("chaos", op="clear", timeout=30)
+        fi.clear()
