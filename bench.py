@@ -1440,6 +1440,114 @@ def bench_autoscale_subprocess():
         f"autoscale bench rc={proc.returncode}: {proc.stderr[-400:]}")
 
 
+def _oom_bench(n_tasks=60, alloc_mb=220, hold_s=0.25):
+    """Runs as a subprocess: a head (0 CPUs) + 3 worker agents, each
+    under a VIRTUAL 512MB memory envelope
+    (memory_monitor_node_total_bytes — per-agent watchdog accounting
+    sums only that agent's worker RSS, so several "nodes" on one host
+    stay isolated and the real machine is never stressed).  The
+    workload overcommits ~2x: two 220MB allocators per 512MB node push
+    past the 0.85 threshold, the watchdog kills the ballooning worker
+    with a typed receipt, and the owner's separate OOM budget retries
+    with jittered backoff until pressure clears.  Contracts: ZERO agent
+    deaths (the watchdog fires, never the kernel), >= 99% task success,
+    and an always-OOM poison class quarantined within
+    poison_task_threshold kills (typed PoisonedTaskError, not worker
+    churn)."""
+    MB = 1024 * 1024
+    threshold = 5
+    os.environ.update({
+        "RT_MEMORY_MONITOR_NODE_TOTAL_BYTES": str(512 * MB),
+        "RT_MEMORY_USAGE_THRESHOLD": "0.85",
+        "RT_MEMORY_MONITOR_REFRESH_MS": "50",
+        "RT_MEMORY_MONITOR_MIN_KILL_INTERVAL_MS": "150",
+        "RT_TASK_OOM_RETRIES": "30",
+        "RT_TASK_RETRY_DELAY_MS": "50",
+        "RT_TASK_OOM_RETRY_MAX_BACKOFF_MS": "1000",
+        "RT_POISON_TASK_THRESHOLD": str(threshold),
+        "RT_POISON_TASK_TTL_S": "120",
+    })
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 0})
+    workers = [cluster.add_node(num_cpus=2) for _ in range(3)]
+    ray_tpu.init(address=cluster.address)
+    try:
+        cluster.wait_for_nodes(4)
+
+        @ray_tpu.remote(max_retries=0, name="oom_bench_alloc")
+        def allocator(i):
+            hoard = bytearray(alloc_mb * MB)
+            for off in range(0, len(hoard), 4096):
+                hoard[off] = 1  # touched pages: real RSS
+            time.sleep(hold_s)
+            return i
+
+        t0 = time.perf_counter()
+        refs = [allocator.remote(i) for i in range(n_tasks)]
+        ok = 0
+        failures = []
+        for i, r in enumerate(refs):
+            try:
+                assert ray_tpu.get(r, timeout=300) == i
+                ok += 1
+            except Exception as exc:  # noqa: BLE001
+                failures.append(f"{type(exc).__name__}: {exc}"[:120])
+        wall = time.perf_counter() - t0
+
+        # poison phase: a class that ALWAYS balloons past the threshold
+        # and never finishes — must quarantine within `threshold` kills
+        # instead of churning workers forever
+        @ray_tpu.remote(max_retries=0, name="oom_bench_poison")
+        def poison():
+            hoard = bytearray(520 * MB)
+            for off in range(0, len(hoard), 4096):
+                hoard[off] = 1
+            time.sleep(300)
+            return len(hoard)
+
+        poisoned_type = ""
+        try:
+            ray_tpu.get(poison.remote(), timeout=240)
+        except Exception as exc:  # noqa: BLE001
+            poisoned_type = type(exc).__name__
+        head = ray_tpu.api._worker().head
+        q = head.call("quarantine", op="list")["entries"]
+        poison_entry = next(
+            (e for e in q.values() if e["name"] == "oom_bench_poison"), {})
+        agents_alive = sum(1 for w in workers if w.alive)
+        out = {
+            "oom_tasks_total": n_tasks,
+            "oom_task_success_pct": round(100.0 * ok / n_tasks, 2),
+            "oom_workload_wall_s": round(wall, 1),
+            "oom_agents_alive": agents_alive,          # contract: 3
+            "oom_poison_error": poisoned_type,         # PoisonedTaskError
+            "oom_poison_kills": poison_entry.get("kills", -1),
+            "oom_poison_quarantined": bool(
+                poison_entry.get("quarantined")),
+            "oom_failures": failures[:3],
+        }
+        print("OOMJSON " + json.dumps(out))
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
+def bench_oom_subprocess():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--oom-bench"],
+        capture_output=True, text=True, timeout=600, cwd=REPO)
+    for line in proc.stdout.splitlines():
+        if line.startswith("OOMJSON "):
+            return json.loads(line[len("OOMJSON "):])
+    raise RuntimeError(
+        f"oom bench rc={proc.returncode}: {proc.stderr[-400:]}")
+
+
 def bench_chaos_subprocess():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--chaos-bench"],
@@ -1724,6 +1832,12 @@ def main():
     # cluster; contract: autoscale_availability_pct >= 99 through both
     # the scale-up and the drain-based scale-down event
     phase("autoscale", lambda: extras.update(bench_autoscale_subprocess()))
+    # oom_resilience: 3 virtual-envelope nodes, a workload overcommitting
+    # node memory ~2x; contracts: zero agent deaths (watchdog kills, not
+    # the kernel), >= 99% task success via the separate OOM retry
+    # budget, and a poison class quarantined within
+    # poison_task_threshold kills with a typed error
+    phase("oom_resilience", lambda: extras.update(bench_oom_subprocess()))
 
     # pipeline phase: CPU-only subprocess cluster (2 MPMD stages over
     # channels vs the single-program baseline, best-of alternating pairs)
@@ -1760,6 +1874,9 @@ if __name__ == "__main__":
     elif "--autoscale-bench" in sys.argv:
         sys.path.insert(0, REPO)
         _autoscale_bench()
+    elif "--oom-bench" in sys.argv:
+        sys.path.insert(0, REPO)
+        _oom_bench()
     elif "--client-bench" in sys.argv:
         sys.path.insert(0, REPO)
         i = sys.argv.index("--client-bench")

@@ -10,6 +10,7 @@ from ray_tpu._private.errors import (ActorDiedError, ActorUnavailableError,
                                      DeadlineExceededError,
                                      DeploymentFailedError, GetTimeoutError,
                                      ObjectFreedError, ObjectLostError,
+                                     OutOfMemoryError, PoisonedTaskError,
                                      RayError, RayTaskError, RayWorkerError,
                                      RuntimeEnvSetupError, SchedulingError,
                                      TaskCancelledError)
@@ -32,5 +33,6 @@ __all__ = [
     "ActorUnavailableError", "ObjectLostError", "ObjectFreedError",
     "GetTimeoutError", "SchedulingError", "RuntimeEnvSetupError",
     "TaskCancelledError", "DeploymentFailedError", "DeadlineExceededError",
+    "OutOfMemoryError", "PoisonedTaskError",
     "__version__",
 ]

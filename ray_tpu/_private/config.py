@@ -87,6 +87,39 @@ _def("memory_usage_threshold", 0.95)          # node memory fraction
 _def("memory_monitor_refresh_ms", 250)        # 0 disables the monitor
 _def("memory_monitor_min_kill_interval_ms", 1_000)
 _def("memory_monitor_test_usage_file", "")    # test hook: fraction in a file
+# virtual node-memory total: > 0 makes the watchdog compute pressure as
+# sum(per-worker RSS) / this total instead of reading /proc/meminfo —
+# several agents on one host each get an ISOLATED, deterministic memory
+# envelope (tests/bench overcommit a 512MB "node" without ever stressing
+# the real machine), and it doubles as the node's `memory` resource
+# total for bin-packing
+_def("memory_monitor_node_total_bytes", 0)
+# OOM kills draw from this separate per-task retry budget — never from
+# max_retries — with jittered exponential backoff so the retry lands
+# after pressure clears instead of immediately back into the same wall
+# (-1 = unlimited, mirroring max_retries semantics)
+_def("task_oom_retries", 5)
+_def("task_oom_retry_max_backoff_ms", 5_000)
+# --- poison-task quarantine (head.py) ----------------------------------------
+# a task/actor class whose executions OOM-kill or crash workers this
+# many CONSECUTIVE times across the cluster is quarantined: further
+# submissions fail fast with PoisonedTaskError instead of churning
+# workers.  TTL-expiring; `rtpu quarantine clear` lifts it early.
+_def("poison_task_threshold", 3)
+_def("poison_task_ttl_s", 60.0)
+# --- checksummed transfers ---------------------------------------------------
+# CRC32 per object computed at seal, carried in directory entries and
+# the transfer control protocol, verified on pull: a corrupt copy is
+# detected, reported back to its holder (which re-verifies and drops a
+# genuinely-bad secondary), and the pull retries from an alternate
+# holder.  False skips both the seal-time hash and pull verification.
+_def("object_checksums", True)
+# --- put() backpressure ------------------------------------------------------
+# a put whose shm allocation fails while the arena holds bytes that can
+# still free (pinned entries whose pins will release) waits up to this
+# long — bounded further by the ambient deadline — for room before
+# taking the disk-fallback path; 0 restores immediate fallback
+_def("put_backpressure_max_s", 10.0)
 # --- observability ----------------------------------------------------------
 _def("task_events_buffer_size", 10_000)
 _def("metrics_report_interval_ms", 5_000)

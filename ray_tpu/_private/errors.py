@@ -37,6 +37,58 @@ class RayWorkerError(RayError):
     """The worker process executing the task died."""
 
 
+class OutOfMemoryError(RayWorkerError):
+    """The node agent's memory watchdog deliberately killed the worker
+    running this task because node memory crossed
+    ``memory_usage_threshold`` — a kill with a receipt, not a mystery
+    death (reference: python/ray/exceptions.py OutOfMemoryError +
+    memory_monitor.h).  Carries the victim's RSS and the node's memory
+    breakdown at kill time.  Subclasses RayWorkerError so every handler
+    that treats worker death as retriable replica/worker loss (Serve
+    dead-replica retry, the circuit breaker's error accounting) applies
+    unchanged.  Owner-side, OOM kills draw from the separate
+    ``task_oom_retries`` budget — never from ``max_retries``."""
+
+    def __init__(self, message: str = "worker killed by the memory "
+                 "monitor", rss_bytes: int = 0, node_usage: float = 0.0,
+                 node_id: str = "", worker_id: str = "",
+                 breakdown: dict | None = None):
+        self.rss_bytes = int(rss_bytes)
+        self.node_usage = float(node_usage)
+        self.node_id = node_id
+        self.worker_id = worker_id
+        # node memory breakdown at kill time (per-worker RSS list +
+        # store arena buckets) — the "receipt" the owner can log/act on
+        self.breakdown = dict(breakdown or {})
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (type(self), (str(self.args[0]) if self.args else "",
+                             self.rss_bytes, self.node_usage,
+                             self.node_id, self.worker_id,
+                             self.breakdown))
+
+
+class PoisonedTaskError(RayError):
+    """Submissions of this task/actor class are quarantined: its
+    executions OOM-killed or crashed workers ``poison_task_threshold``
+    consecutive times across the cluster, so further attempts would
+    only churn workers.  Fails fast at submission/lease time with the
+    kill history instead of burning retries into the same wall.  The
+    quarantine expires after ``poison_task_ttl_s`` and can be lifted
+    early via ``rtpu quarantine clear``."""
+
+    def __init__(self, message: str = "task class is quarantined",
+                 key: str = "", history: list | None = None):
+        self.key = key          # function/class id the quarantine keys on
+        self.history = list(history or [])  # human-readable kill records
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (type(self), (str(self.args[0]) if self.args else "",
+                             self.key, self.history))
+
+
 class ActorDiedError(RayError):
     """The actor is dead (creation failed, killed, or out of restarts)."""
 

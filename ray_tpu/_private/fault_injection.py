@@ -25,6 +25,13 @@ happen:
                    stall.  The worker stays ALIVE (heartbeats, probes
                    answered late, nothing crashes): the GRAY-failure
                    generator, distinct from kill
+  ``worker.oom``   the executing worker, just before running the task
+                   body (worker.py; key = function_id) — action: oom.
+                   Allocates real touched pages in steps until the
+                   node memory watchdog kills it: exercises RSS
+                   sampling, victim selection, the typed
+                   OutOfMemoryError receipt, and the owner's separate
+                   OOM retry budget end to end
   ``agent.kill``   node agent SIGKILLs itself (key = node_id) — action:
                    kill
   ``head.kill``    head service SIGKILLs itself (key = "head") —
@@ -63,9 +70,10 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 SITES = ("rpc.send", "rpc.recv", "xfer.send", "lease.grant",
-         "worker.kill", "worker.stall", "agent.kill", "head.kill")
+         "worker.kill", "worker.stall", "worker.oom", "agent.kill",
+         "head.kill")
 ACTIONS = ("drop", "delay", "sever", "truncate", "corrupt", "kill",
-           "stall")
+           "stall", "oom")
 
 _rule_ids = itertools.count(1)
 
@@ -276,7 +284,8 @@ def make_schedule(seed: int, sites: Sequence[str],
     default_action = {"rpc.send": "drop", "rpc.recv": "drop",
                       "xfer.send": "truncate", "lease.grant": "delay",
                       "worker.kill": "kill", "worker.stall": "stall",
-                      "agent.kill": "kill", "head.kill": "kill"}
+                      "worker.oom": "oom", "agent.kill": "kill",
+                      "head.kill": "kill"}
     rng = random.Random(seed)
     rules: List[Dict[str, Any]] = []
     for site in sites:

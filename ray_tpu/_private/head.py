@@ -135,7 +135,7 @@ class _NodeEntry:
     __slots__ = ("node_id", "host", "port", "arena_path", "resources",
                  "last_heartbeat", "client", "is_head_node",
                  "pending_demands", "labels", "xfer_port", "memory",
-                 "draining")
+                 "draining", "pressure")
 
     def __init__(self, node_id: str, host: str, port: int, arena_path: str,
                  resources: NodeResources, is_head_node: bool,
@@ -160,6 +160,9 @@ class _NodeEntry:
         # latest store byte breakdown off this node's heartbeat — the
         # cheap (no fan-out) half of /api/memory and rtpu summary
         self.memory: Dict[str, Any] = {}
+        # latest watchdog-sampled memory usage fraction (heartbeat);
+        # rides the cluster view so pick_node demotes pressured nodes
+        self.pressure: Optional[float] = None
         # graceful scale-down: a DRAINING node grants no new leases and
         # is excluded from every placement decision; the drain state
         # machine (HeadService._drain_task) owns the flag's lifecycle
@@ -278,6 +281,16 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         # node_id -> {rule_id: fired} from heartbeats (current version
         # only); status aggregates these with the head's own counts
         self._chaos_fired: Dict[str, Dict[str, int]] = {}
+        # poison-task quarantine: fid -> {kills, history, until, name,
+        # detail}.  Owners report each worker kill their class caused
+        # (task_kill_report) and the first success after one
+        # (task_ok_report, resetting the CONSECUTIVE count); at
+        # poison_task_threshold kills the class quarantines for
+        # poison_task_ttl_s — agents refuse its leases (gossiped on
+        # heartbeat replies, version-gated like chaos rules) and owners
+        # fail submissions fast with PoisonedTaskError.
+        self._poison: Dict[str, Dict[str, Any]] = {}
+        self._quarantine_version = 1
         # memory/object accounting (rtpu memory): registered driver
         # callback addresses by job id (bounded — oldest fall off), the
         # pooled clients to them, and the periodic leak-scan task that
@@ -557,7 +570,9 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                             dir_versions: Optional[List[int]] = None,
                             metrics: Optional[Dict[str, float]] = None,
                             memory: Optional[Dict[str, Any]] = None,
+                            pressure: Optional[float] = None,
                             seen_chaos_version: int = 0,
+                            seen_quarantine_version: int = 0,
                             chaos_fired: Optional[Dict[str, int]] = None):
         entry = self.nodes.get(node_id)
         if entry is None:
@@ -565,6 +580,8 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         entry.last_heartbeat = time.monotonic()
         if memory:
             entry.memory = memory
+        if pressure is not None:
+            entry.pressure = float(pressure)
         if metrics:
             now = time.time()
             for name, value in metrics.items():
@@ -599,6 +616,10 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         elif chaos_fired:
             # counts only make sense against the CURRENT rule set
             self._chaos_fired[node_id] = dict(chaos_fired)
+        if self._poison:
+            self._prune_quarantine()
+        if seen_quarantine_version != self._quarantine_version:
+            reply["quarantine"] = self._quarantine_payload()
         return reply
 
     async def rpc_object_locations(self, oids: List[str]):
@@ -1027,6 +1048,124 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         asyncio.get_event_loop().call_later(
             delay, lambda: os.kill(os.getpid(), signal.SIGKILL))
 
+    # ---- poison-task quarantine --------------------------------------------
+
+    def _prune_quarantine(self) -> None:
+        """Drop expired quarantines (TTL) — their kill counts restart
+        from zero, so a class that still OOMs re-trips after another
+        full threshold's worth of kills, not instantly.  UNTRIPPED
+        watch entries expire on the same TTL measured from their LAST
+        kill: "consecutive" means within a window, not ever — rare
+        input-dependent kills spread over days (from short-lived
+        drivers whose successes never send ok-reports) must not
+        accumulate into a quarantine, and the table stays bounded."""
+        now = time.time()
+        ttl = float(config.poison_task_ttl_s)
+        expired = [k for k, ent in self._poison.items()
+                   if (ent.get("until") and now >= ent["until"])
+                   or (not ent.get("until")
+                       and now - ent.get("last_kill", now) >= ttl)]
+        for k in expired:
+            self._poison.pop(k, None)
+        if expired:
+            self._quarantine_version += 1
+            self._set_quarantine_gauge()
+
+    def _set_quarantine_gauge(self) -> None:
+        from ray_tpu._private.metrics import memory_pressure_metrics
+
+        memory_pressure_metrics()[2].set(
+            sum(1 for e in self._poison.values() if e.get("until")))
+
+    def _quarantine_payload(self) -> Dict[str, Any]:
+        """The gossiped enforcement set: only TRIPPED entries (agents
+        need nothing for classes still accumulating kills)."""
+        return {"version": self._quarantine_version,
+                "entries": {k: {"until": e["until"],
+                                "detail": e["detail"],
+                                "history": e["history"][-8:]}
+                            for k, e in self._poison.items()
+                            if e.get("until")}}
+
+    def _quarantine_verdict(self, ent: Dict[str, Any]) -> Dict[str, Any]:
+        return {"quarantined": bool(ent.get("until")),
+                "until": ent.get("until", 0.0),
+                "detail": ent.get("detail", ""),
+                "history": ent.get("history", [])[-8:]}
+
+    async def rpc_task_kill_report(self, key: str, kind: str = "crash",
+                                   name: str = "", node_id: str = ""):
+        """An owner's (or this head's, for actors) report that one
+        execution of class `key` killed its worker.  Crossing
+        ``poison_task_threshold`` consecutive kills trips the
+        quarantine; the reply carries the verdict so the reporter can
+        fail its next submissions fast without waiting for gossip."""
+        self._prune_quarantine()
+        ent = self._poison.get(key)
+        if ent is None:
+            ent = self._poison[key] = {"kills": 0, "history": [],
+                                       "until": 0.0, "name": name,
+                                       "detail": "", "last_kill": 0.0}
+        if name:
+            ent["name"] = name
+        ent["kills"] += 1
+        ent["last_kill"] = time.time()
+        ent["history"].append(
+            f"{kind} on node {node_id[:12] or '?'} at "
+            f"{time.strftime('%H:%M:%S')}")
+        del ent["history"][:-32]
+        if not ent["until"] and ent["kills"] >= int(
+                config.poison_task_threshold):
+            ttl = float(config.poison_task_ttl_s)
+            ent["until"] = time.time() + ttl
+            ent["detail"] = (
+                f"task class {ent['name'] or key[:12]!r} is quarantined: "
+                f"its executions killed workers {ent['kills']} "
+                f"consecutive times across the cluster "
+                f"({'; '.join(ent['history'][-int(config.poison_task_threshold):])}); "
+                f"expires in {ttl:.0f}s or `rtpu quarantine clear`")
+            self._quarantine_version += 1
+            self._set_quarantine_gauge()
+            self.publish("error_info", {"kind": "task_quarantined",
+                                        "key": key, "name": ent["name"],
+                                        "detail": ent["detail"]})
+        return self._quarantine_verdict(ent)
+
+    async def rpc_task_ok_report(self, key: str):
+        """A real completion of a class with kill history: the
+        consecutive-kill count resets.  An ACTIVE quarantine is not
+        lifted here (TTL/CLI only) — the success raced the trip."""
+        ent = self._poison.get(key)
+        if ent is not None and not ent.get("until"):
+            self._poison.pop(key, None)
+        return {"ok": True}
+
+    async def rpc_quarantine(self, op: str = "list", key: str = ""):
+        """`rtpu quarantine` backend: op=list dumps the table (tripped
+        AND still-accumulating entries), op=clear lifts one key ("" =
+        every tripped entry) immediately."""
+        self._prune_quarantine()
+        if op == "clear":
+            cleared = []
+            for k in ([key] if key else
+                      [k for k, e in self._poison.items() if e["until"]]):
+                if self._poison.pop(k, None) is not None:
+                    cleared.append(k)
+            if cleared:
+                self._quarantine_version += 1
+                self._set_quarantine_gauge()
+            return {"cleared": cleared}
+        if op != "list":
+            raise RpcError(f"unknown quarantine op {op!r}")
+        now = time.time()
+        return {"entries": {
+            k: {"name": e["name"], "kills": e["kills"],
+                "quarantined": bool(e["until"]),
+                "expires_in_s": round(max(0.0, e["until"] - now), 1)
+                if e["until"] else 0.0,
+                "history": e["history"][-8:]}
+            for k, e in self._poison.items()}}
+
     def _chaos_payload(self) -> Dict[str, Any]:
         return {"rules": list(self._chaos_rules),
                 "version": self._chaos_version}
@@ -1052,7 +1191,9 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         return {nid: {"addr": [n.host, n.port],
                       "res": n.resources.to_dict(),
                       "labels": n.labels, "xfer": n.xfer_port,
-                      **({"draining": True} if n.draining else {})}
+                      **({"draining": True} if n.draining else {}),
+                      **({"pressure": n.pressure}
+                         if n.pressure is not None else {})}
                 for nid, n in self.nodes.items()}
 
     def on_peer_disconnect(self, conn) -> None:
@@ -1255,13 +1396,33 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
             entry.wake()
         return {"ok": True}
 
-    async def rpc_worker_died(self, node_id: str, worker_id: str, reason: str = ""):
-        """Node agent reports a worker process death."""
+    async def rpc_worker_died(self, node_id: str, worker_id: str,
+                              reason: str = "",
+                              oom: Optional[Dict[str, Any]] = None):
+        """Node agent reports a worker process death.  ``oom`` is the
+        watchdog's kill receipt when the death was a deliberate
+        memory-pressure kill: an OOM-killed ACTOR counts toward its
+        class's poison quarantine here (normal tasks are counted by
+        their owners, which know exactly which task was running)."""
         self.publish("error_info", {"kind": "worker_died",
                                     "node_id": node_id,
                                     "worker_id": worker_id, "reason": reason})
         for actor in list(self.actors.values()):
             if actor.worker_id == worker_id and actor.state in (ALIVE, PENDING):
+                if oom is not None:
+                    from ray_tpu._private.memory_monitor import \
+                        is_self_poisoning
+
+                    # same self-poisoning gate the owners apply to task
+                    # kills: aggregate-pressure victims don't count
+                    fid = actor.spec_wire.get("fid", "")
+                    if fid and is_self_poisoning(
+                            int(oom.get("rss", 0)),
+                            int(oom.get("limit", 0))):
+                        await self.rpc_task_kill_report(
+                            key=fid, kind="oom",
+                            name=actor.spec_wire.get("name", ""),
+                            node_id=node_id)
                 await self._on_actor_worker_lost(
                     actor, reason or f"worker {worker_id[:8]} died")
         return {"ok": True}
@@ -1362,7 +1523,11 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                     cluster, demand, local_node_id="",
                     strategy=ts.scheduling_strategy,
                     labels_by_node={nid: n.labels
-                                    for nid, n in self.nodes.items()})
+                                    for nid, n in self.nodes.items()},
+                    pressure_by_node={nid: n.pressure
+                                      for nid, n in self.nodes.items()
+                                      if n.pressure is not None},
+                    pressure_threshold=float(config.memory_usage_threshold))
             if nid is None:
                 from ray_tpu._private.node_agent import _is_hard_strategy
 
@@ -1414,12 +1579,14 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
             if "granted" not in lease:
                 if deducted:
                     node.resources.release(demand)
-                if lease.get("error") == "runtime env setup failed":
-                    # deterministic failure: retrying other nodes cannot
-                    # fix a missing/broken env package — fail fast
+                if lease.get("error") in ("runtime env setup failed",
+                                          "poisoned"):
+                    # deterministic failures: retrying other nodes cannot
+                    # fix a missing env package or an actively-quarantined
+                    # class — fail fast with the refusal's detail
                     actor.state = DEAD
                     actor.death_cause = lease.get(
-                        "error_str", "runtime env setup failed")
+                        "error_str", lease["error"])
                     if actor.name:
                         self.named_actors.pop(actor.name, None)
                     actor.wake()

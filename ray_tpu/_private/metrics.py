@@ -552,6 +552,52 @@ def object_store_breakdown_gauge() -> Gauge:
     return _store_breakdown_gauge
 
 
+_memory_pressure_metrics = None
+
+
+def memory_pressure_metrics() -> Tuple[Counter, Gauge, Gauge]:
+    """Process-singleton memory-pressure resilience families (see
+    _private/memory_monitor.py + node_agent watchdog + head.py
+    quarantine): ``ray_tpu_oom_kills_total`` — agent-side, one per
+    watchdog kill, labeled reason=node_pressure|chaos;
+    ``ray_tpu_node_memory_pressure`` — the agent's sampled node memory
+    usage fraction (the watchdog's own gauge, also gossiped on
+    heartbeats for pressure-aware scheduling); and
+    ``ray_tpu_quarantined_tasks`` — head-side, the number of task/actor
+    classes currently quarantined as poison (fail-fast with
+    PoisonedTaskError instead of worker churn)."""
+    global _memory_pressure_metrics
+    if _memory_pressure_metrics is None:
+        _memory_pressure_metrics = (
+            Counter("ray_tpu_oom_kills_total",
+                    "workers deliberately killed by the node memory "
+                    "watchdog, by reason"),
+            Gauge("ray_tpu_node_memory_pressure",
+                  "sampled node memory usage fraction (watchdog input)"),
+            Gauge("ray_tpu_quarantined_tasks",
+                  "task/actor classes currently poison-quarantined"),
+        )
+    return _memory_pressure_metrics
+
+
+_checksum_failures_counter: Optional[Counter] = None
+
+
+def object_checksum_failures_counter() -> Counter:
+    """Process-singleton ``ray_tpu_object_checksum_failures_total``:
+    bulk-pull payloads whose CRC32 did not match the holder's seal-time
+    checksum — the pull quarantines that copy (the holder re-verifies
+    and drops a genuinely-corrupt secondary) and retries from an
+    alternate holder, so a nonzero rate means corruption is being
+    CAUGHT, not served."""
+    global _checksum_failures_counter
+    if _checksum_failures_counter is None:
+        _checksum_failures_counter = Counter(
+            "ray_tpu_object_checksum_failures_total",
+            "object pulls whose payload failed CRC32 verification")
+    return _checksum_failures_counter
+
+
 _autoscaler_metrics = None
 
 

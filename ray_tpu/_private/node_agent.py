@@ -32,7 +32,7 @@ import sys
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import fault_injection
+from ray_tpu._private import fault_injection, memory_monitor
 from ray_tpu._private.config import config
 from ray_tpu._private.errors import RuntimeEnvSetupError
 from ray_tpu._private.ids import NodeID, WorkerID
@@ -45,12 +45,13 @@ from ray_tpu._private.object_transfer import (ObjectTransferClient,
 from ray_tpu._private.resources import NodeResources, ResourceSet
 from ray_tpu._private.rpc import RpcClient, RpcHost, RpcServer
 from ray_tpu._private.scheduler import LocalScheduler, pick_node
-from ray_tpu._private.task_spec import TaskSpec
+from ray_tpu._private.task_spec import NORMAL_TASK, TaskSpec
 
 
 class _Worker:
     __slots__ = ("worker_id", "pid", "proc", "port", "ready", "lease_id",
-                 "started_at", "env_key", "idle_since", "iclient")
+                 "started_at", "env_key", "idle_since", "iclient",
+                 "pinned", "saving")
 
     def __init__(self, worker_id: str, proc: subprocess.Popen,
                  env_key: str = ""):
@@ -61,6 +62,11 @@ class _Worker:
         self.ready = asyncio.Event()
         self.lease_id: Optional[str] = None
         self.started_at = time.monotonic()
+        # OOM victim-policy flags, pushed by the worker itself
+        # (worker_flags oneway): running a pinned __rt_dag_* loop /
+        # mid-__rt_save__ snapshot — both are last-resort victims
+        self.pinned = False
+        self.saving = False
         # pooled introspection client (stacks/profile/memory fan-outs):
         # the periodic memory scan would otherwise dial a fresh TCP
         # connection per worker per scan, forever
@@ -83,11 +89,12 @@ def _is_hard_strategy(strategy: Dict[str, Any]) -> bool:
 class _Lease:
     __slots__ = ("lease_id", "worker", "resources", "bundle_key", "seq",
                  "tpu_chips", "blocked", "donated", "owner_conn",
-                 "owner_id", "owner_addr")
+                 "owner_id", "owner_addr", "retriable", "fid", "task_name")
 
     def __init__(self, lease_id: str, worker: _Worker, resources: ResourceSet,
                  bundle_key: str = "", seq: int = 0, owner_conn=None,
-                 owner_id: str = "", owner_addr=None):
+                 owner_id: str = "", owner_addr=None, retriable: bool = True,
+                 fid: str = "", task_name: str = ""):
         self.lease_id = lease_id
         self.worker = worker
         self.resources = resources
@@ -113,6 +120,14 @@ class _Lease:
         # — CPU only; accelerators stay bound to their chip assignment)
         self.blocked = False
         self.donated: Optional[ResourceSet] = None  # what blocking released
+        # OOM victim policy inputs, from the granting spec: whether the
+        # class's tasks are retriable (an adopted same-shape class can
+        # differ per task — the granting spec is the agent's best view),
+        # and the function/class id + name for the kill receipt and the
+        # head's poison-task accounting
+        self.retriable = retriable
+        self.fid = fid
+        self.task_name = task_name
 
 
 class NodeAgent(IntrospectionRpcMixin, RpcHost):
@@ -135,6 +150,28 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         # implicit resource in common/scheduling)
         resources = dict(resources)
         resources.setdefault(f"node:{self.node_id[:12]}", 1.0)
+        # real memory bin-packing: tasks declaring `memory=` in
+        # .options() reserve bytes against this node total — the virtual
+        # watchdog envelope when set, else the host's MemTotal
+        # (reference: the "memory" resource in ray_constants/_raylet)
+        mem_total = int(config.memory_monitor_node_total_bytes)
+        if mem_total <= 0:
+            try:
+                with open("/proc/meminfo") as f:
+                    for line in f:
+                        if line.startswith("MemTotal"):
+                            mem_total = int(line.split()[1]) * 1024
+                            break
+            except OSError:
+                pass
+        # the node's memory budget in bytes (virtual envelope or
+        # MemTotal): the `memory` resource total for bin-packing, and
+        # the denominator behind the kill receipts' self-poisoning
+        # discriminator (a victim whose OWN RSS exceeds
+        # threshold*total can never fit, even alone)
+        self._mem_total_bytes = max(0, mem_total)
+        if "memory" not in resources and mem_total > 0:
+            resources["memory"] = float(mem_total)
         self.resources = NodeResources(ResourceSet(resources))
         # concrete chip indices behind the fungible "TPU" count: leases
         # holding TPU resources get specific chips, exported to the task
@@ -173,7 +210,7 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         self.xfer_stats: Dict[str, int] = {
             "pulls": 0, "bulk_pulls": 0, "rpc_pulls": 0, "bytes_in": 0,
             "prefetch_started": 0, "alt_source_retries": 0,
-            "bulk_fallbacks": 0}
+            "bulk_fallbacks": 0, "checksum_failures": 0}
         # worker pool
         self._workers: Dict[str, _Worker] = {}   # worker_id -> worker
         self._idle: List[_Worker] = []
@@ -218,10 +255,21 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         self._last_loop_lag = 0.0
         # chaos gossip state: last rule-set version applied from the head
         self._seen_chaos_version = 0
+        # memory watchdog state: last sampled node pressure (rides
+        # heartbeats into the cluster view for pressure-aware
+        # scheduling), receipts for kills awaiting the head report, and
+        # the head-gossiped poison-task quarantine (fid -> detail dict)
+        self._last_pressure: Optional[float] = None
+        self._oom_reported: Dict[str, Dict[str, Any]] = {}
+        self._quarantine: Dict[str, Dict[str, Any]] = {}
+        self._seen_quarantine_version = 0
         # graceful scale-down: while draining this agent grants no new
         # leases (owners re-route on the head's drained cluster view),
         # advertises no pending demand, and has its warm leases reclaimed
         self._draining = False
+        # set by stop(): loops that might swallow their cancellation
+        # (wait_for racing a wake event) exit on it instead
+        self._stopping = False
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -372,6 +420,11 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         return result
 
     async def stop(self):
+        # belt for a 3.10 wait_for edge: a cancel landing exactly as
+        # _hb_wake fires can be swallowed by the wait (bpo-42130 family),
+        # leaving the heartbeat loop alive against a closed head forever
+        # — the flag makes the next iteration exit regardless
+        self._stopping = True
         self._log.stop()
         for t in self._tasks:
             t.cancel()
@@ -467,6 +520,52 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
             return
         fault_injection.install(payload.get("rules", []), version)
         self._run_chaos_kills()
+        self._forward_chaos_to_workers(payload)
+
+    def _forward_chaos_to_workers(self, payload: Dict[str, Any]) -> None:
+        """Worker-side chaos sites (worker.oom, rpc.*) need the rules in
+        the WORKER processes: newborns get them via the spawn env
+        (RT_CHAOS_RULES); already-running pooled workers get this
+        best-effort push over the introspection client."""
+
+        async def _one(w: _Worker):
+            try:
+                await self._call_worker(w, "chaos_rules", timeout=5.0,
+                                        rules=payload.get("rules", []),
+                                        version=payload.get("version"))
+            except Exception:
+                pass
+
+        for w in list(self._workers.values()):
+            if w.ready.is_set() and w.proc.poll() is None:
+                asyncio.ensure_future(_one(w))
+
+    def _apply_quarantine(self, payload: Optional[Dict[str, Any]]) -> None:
+        """Install the head-gossiped poison-task quarantine set (full
+        replacement, idempotent by version): lease requests for a
+        quarantined function/class id are refused with a typed
+        "poisoned" error so enforcement is cluster-wide within one
+        heartbeat of the quarantine tripping."""
+        if not payload:
+            return
+        version = payload.get("version", 0)
+        if version == self._seen_quarantine_version:
+            return
+        self._seen_quarantine_version = version
+        self._quarantine = dict(payload.get("entries") or {})
+
+    def _quarantined_entry(self, fid: str) -> Optional[Dict[str, Any]]:
+        """The live quarantine record for fid, or None.  TTL expiry is
+        enforced here too (belt and braces — the head also prunes), so
+        a stale gossiped entry can never outlive its window."""
+        ent = self._quarantine.get(fid)
+        if ent is None:
+            return None
+        until = float(ent.get("until", 0.0))
+        if until and time.time() >= until:
+            self._quarantine.pop(fid, None)
+            return None
+        return ent
 
     def _run_chaos_kills(self) -> None:
         chaos = fault_injection.decide("agent.kill", key=self.node_id)
@@ -567,7 +666,7 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
 
     async def _heartbeat_loop(self):
         period = config.gcs_health_check_period_ms / 1000.0
-        while True:
+        while not self._stopping:
             try:
                 # object report as a DELTA vs what the head last acked:
                 # a steady-state beat costs O(1) directory bytes no
@@ -585,9 +684,12 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
                     dir_versions=self._dir_mirror.seen_versions(),
                     metrics=self._metric_summary(),
                     memory=self._memory_breakdown(max_age_s=5.0),
+                    pressure=self._last_pressure,
                     seen_chaos_version=self._seen_chaos_version,
+                    seen_quarantine_version=self._seen_quarantine_version,
                     chaos_fired=fault_injection.fired_counts() or None)
                 self._apply_chaos(reply.get("chaos"))
+                self._apply_quarantine(reply.get("quarantine"))
                 if reply.get("unknown_node"):
                     # the head restarted without our entry (or reaped us
                     # during its downtime): re-register under the SAME
@@ -626,7 +728,11 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
 
     # ---- object store RPCs (PlasmaClient protocol) -------------------------
 
-    async def rpc_store_create(self, oid: str, size: int, primary: bool = True):
+    async def rpc_store_create(self, oid: str, size: int, primary: bool = True,
+                               wait_s: float = 0.0):
+        if wait_s > 0:
+            return await self.store.create_with_backpressure(
+                oid, size, primary=primary, wait_s=float(wait_s))
         return self.store.create(oid, size, primary=primary)
 
     async def rpc_store_seal(self, oid: str):
@@ -827,13 +933,44 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
     # kept as the compat/fallback path (and the bench baseline).
 
     async def rpc_obj_info(self, oid: str, pin_for: str = ""):
-        """Peer asks for size before pulling; pins so chunks stay valid."""
+        """Peer asks for size before pulling; pins so chunks stay valid.
+        Carries the seal-fixed CRC32 so the puller can verify the
+        payload it assembles (checksummed transfers).  A first-export
+        hash runs on an executor thread — the entry is pinned (above)
+        and sealed bytes are immutable, and a multi-GB crc32 must not
+        stall the control loop."""
         locs = await self.store.get([oid], pin_for or "xfer", wait_timeout=0.0)
         loc = locs[0]
         if loc is None or loc.get("deleted"):
             return {"found": False}
+        crc = await asyncio.get_running_loop().run_in_executor(
+            None, self.store.checksum, oid)
         return {"found": True, "size": loc["size"],
-                "xfer_port": self.xfer_port}
+                "xfer_port": self.xfer_port, "crc": crc}
+
+    async def rpc_obj_corrupt(self, oid: str, reporter: str = ""):
+        """A puller's payload from US failed checksum verification:
+        re-hash our own copy against its seal-time CRC.  A genuinely
+        corrupt SECONDARY copy is dropped (the directory stops
+        advertising it within a beat; primaries stay — dropping the
+        only durable copy converts detected corruption into data loss,
+        and lineage reconstruction is the owner's call).  An intact
+        copy means the corruption was in transit — nothing to do, the
+        puller's alternate-holder retry (or a fresh stream) covers it."""
+        verdict = await asyncio.get_running_loop().run_in_executor(
+            None, self.store.verify_crc, oid)
+        if verdict is False:
+            entry = self.store.objects.get(oid)
+            if entry is not None and not entry.primary:
+                # evict the copy only — free() would mark the oid
+                # owner-deleted here and fail local getters with
+                # "freed" though the owner never freed it
+                dropped = self.store.drop_copy(oid)
+                if dropped:
+                    self._hb_wake.set()  # directory: this holder is gone
+                return {"dropped": dropped}
+            return {"dropped": False, "corrupt_primary": True}
+        return {"dropped": False, "intact": verdict is True}
 
     async def rpc_obj_chunk(self, oid: str, offset: int, length: int):
         # memoryview reply: msgpack serializes buffer-protocol objects
@@ -954,6 +1091,11 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
                         await self._pull_chunks_rpc(peer, oid, size, loc)
                 else:
                     await self._pull_chunks_rpc(peer, oid, size, loc)
+                # verify OUTSIDE the bulk-fallback try: a checksum
+                # mismatch must go to an ALTERNATE holder (the retry in
+                # _pull_with_retry), never refetch the same corrupt
+                # source over a different plane
+                await self._verify_pull(oid, loc, info.get("crc"), peer)
                 self.store.seal(oid)
             except BaseException:
                 self.store.abort(oid)
@@ -973,6 +1115,49 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         self.xfer_stats["bytes_in"] += size
         if self._directory_worthy(size):
             self._hb_wake.set()  # new holder: refresh the directory fast
+
+    async def _verify_pull(self, oid: str, loc: Dict[str, Any],
+                           expected_crc, peer: RpcClient) -> None:
+        """Checksum the just-assembled pull payload against the
+        holder's seal-time CRC32.  A mismatch counts in
+        ray_tpu_object_checksum_failures_total, tells the holder to
+        re-verify (it drops a genuinely-corrupt secondary — the
+        quarantined copy), and raises TransferError so the pull retries
+        from an alternate holder via the existing alt-source path —
+        the xfer.corrupt chaos site becomes detectable end to end
+        instead of silent pickle roulette."""
+        if expected_crc is None or not config.object_checksums:
+            return
+        entry = self.store.objects.get(oid)
+        if entry is None:
+            return  # aborted underneath us: nothing to verify
+        # executor thread: a multi-GB hash must not stall the agent
+        # control loop (heartbeats, lease grants, watchdog ticks) — the
+        # unsealed allocation is exclusively ours until seal, so the
+        # entry's bytes are stable off-loop.  compute_crc handles the
+        # shm/disk location split in ONE place
+        actual = await asyncio.get_running_loop().run_in_executor(
+            None, self.store.compute_crc, entry)
+        if actual is None:
+            return  # bytes unreadable: cannot verify, let the seal land
+        if actual == int(expected_crc):
+            entry.crc = int(expected_crc)  # verified: no later re-hash
+            return
+        from ray_tpu._private.metrics import \
+            object_checksum_failures_counter
+
+        object_checksum_failures_counter().inc()
+        self.xfer_stats["checksum_failures"] = \
+            self.xfer_stats.get("checksum_failures", 0) + 1
+        try:
+            await peer.oneway("obj_corrupt", oid=oid,
+                              reporter=self.node_id)
+        except Exception:
+            pass
+        raise TransferError(
+            f"checksum mismatch pulling {oid[:16]}: payload crc "
+            f"{actual:#010x} != sealed crc {int(expected_crc):#010x} "
+            f"(copy reported to holder; retrying from an alternate)")
 
     async def _pull_chunks_rpc(self, peer: RpcClient, oid: str, size: int,
                                loc: Dict[str, Any]):
@@ -1095,6 +1280,13 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
             # 8KB block flush
             "PYTHONUNBUFFERED": "1",
         })
+        chaos_state = fault_injection.status()
+        if chaos_state.get("rules"):
+            # worker-side chaos sites (worker.oom, rpc.*) fire in the
+            # worker process: ship the live rule set with the spawn
+            import json as _json
+
+            env["RT_CHAOS_RULES"] = _json.dumps(chaos_state)
         if working_dir:
             env["RT_WORKING_DIR"] = working_dir
         if path_dirs:
@@ -1183,75 +1375,146 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
 
     # ---- memory monitor ----------------------------------------------------
 
-    def _memory_usage_fraction(self) -> Optional[float]:
-        """Node memory pressure in [0, 1]; None if unreadable.
-        The test hook file (memory_monitor_test_usage_file) overrides the
-        /proc/meminfo reading so OOM behavior is testable without
-        actually exhausting the host."""
-        test_file = config.memory_monitor_test_usage_file
-        if test_file:
-            try:
-                with open(test_file) as f:
-                    return float(f.read().strip())
-            except (OSError, ValueError):
-                return None
-        try:
-            fields = {}
-            with open("/proc/meminfo") as f:
-                for line in f:
-                    key, _, rest = line.partition(":")
-                    fields[key] = int(rest.split()[0])
-            total = fields.get("MemTotal", 0)
-            avail = fields.get("MemAvailable", fields.get("MemFree", 0))
-            if total <= 0:
-                return None
-            return 1.0 - avail / total
-        except (OSError, ValueError):
-            return None
-
-    def _pick_oom_victim(self) -> Optional[_Worker]:
-        """Newest-leased worker first (reference: memory_monitor.h policy
-        via worker_killing_policy.cc — kill the task submitted last, so
-        long-running earlier work keeps its progress)."""
-        for lease in sorted(self._leases.values(),
-                            key=lambda l: l.seq, reverse=True):
+    def _worker_samples(self) -> List[memory_monitor.WorkerSample]:
+        """Per-LEASED-worker RSS + policy flags for this tick.  Only
+        leased workers are candidates — an idle pooled worker holds no
+        task to retry and its memory is the interpreter baseline."""
+        out: List[memory_monitor.WorkerSample] = []
+        for lease in self._leases.values():
             w = lease.worker
-            if w.proc.poll() is None:
-                return w
-        return None
+            if w.proc.poll() is not None:
+                continue
+            rss = memory_monitor.read_rss_bytes(w.pid)
+            if rss is None:
+                continue
+            out.append(memory_monitor.WorkerSample(
+                worker_id=w.worker_id, rss=rss, lease_seq=lease.seq,
+                retriable=lease.retriable, pinned=w.pinned,
+                saving=w.saving, fid=lease.fid, name=lease.task_name))
+        return out
+
+    def _memory_usage_fraction(
+            self, samples: Optional[List] = None) -> Optional[float]:
+        """Node memory pressure in [0, 1]; None if unreadable.  Sources
+        (memory_monitor.usage_fraction): the test hook file, the virtual
+        per-agent envelope (memory_monitor_node_total_bytes), or
+        /proc/meminfo."""
+        virtual = int(config.memory_monitor_node_total_bytes)
+        rss_sum = 0
+        if virtual > 0:
+            if samples is None:
+                samples = self._worker_samples()
+            rss_sum = sum(s.rss for s in samples)
+        return memory_monitor.usage_fraction(
+            config.memory_monitor_test_usage_file, virtual, rss_sum)
+
+    def _oom_receipt(self, victim, usage: float,
+                     samples: List) -> Dict[str, Any]:
+        """The typed-kill payload: everything the owner needs to turn a
+        worker death into a retriable OutOfMemoryError with evidence."""
+        return {
+            "worker_id": victim.worker_id,
+            "node_id": self.node_id,
+            "rss": victim.rss,
+            "usage": usage,
+            "threshold": float(config.memory_usage_threshold),
+            # the node's kill ceiling in bytes: victims whose own RSS
+            # approaches it are SELF-poisoning — the poison-quarantine
+            # counter only counts those, so contention victims of
+            # aggregate pressure retry without building a poison record.
+            # 0 (= count every kill) when the test usage-file hook
+            # drives pressure: synthetic usage says nothing about RSS
+            "limit": 0 if config.memory_monitor_test_usage_file
+            else int(self._mem_total_bytes
+                     * float(config.memory_usage_threshold)),
+            "fid": victim.fid,
+            "name": victim.name,
+            "breakdown": {
+                "workers": [[s.worker_id[:12], s.rss] for s in samples],
+                "store": {k: v for k, v in self.store.usage().items()
+                          if isinstance(v, (int, float))},
+            },
+        }
 
     async def _memory_monitor_loop(self):
-        """Kill workers when node memory crosses the threshold, newest
-        lease first; the owner's normal worker-death retry resubmits the
-        task once pressure clears (reference: memory_monitor.h:52)."""
+        """The node OOM watchdog (reference: memory_monitor.h:52):
+        sample usage + per-worker RSS each period; past the threshold,
+        kill the policy's victim (highest-RSS retriable task first,
+        pinned/saving workers last resort — memory_monitor.pick_victim)
+        and reply to the owner with a typed receipt BEFORE the SIGKILL,
+        so the owner's worker-death accounting draws from the separate
+        OOM retry budget instead of max_retries."""
+        from ray_tpu._private.metrics import memory_pressure_metrics
+
         period = config.memory_monitor_refresh_ms / 1000.0
-        min_gap = config.memory_monitor_min_kill_interval_ms / 1000.0
-        last_kill = 0.0
+        watchdog = memory_monitor.OomWatchdog(
+            threshold=float(config.memory_usage_threshold),
+            min_kill_gap_s=config.memory_monitor_min_kill_interval_ms
+            / 1000.0)
+        oom_kills, pressure_gauge, _ = memory_pressure_metrics()
         while True:
             await asyncio.sleep(period)
-            usage = self._memory_usage_fraction()
-            threshold = config.memory_usage_threshold
-            if usage is None or usage < threshold:
-                continue
-            if time.monotonic() - last_kill < min_gap:
-                continue  # let the last kill take effect before another
-            victim = self._pick_oom_victim()
-            if victim is None:
-                continue
-            last_kill = time.monotonic()
-            reason = (f"OOM-killed by the memory monitor: node memory "
-                      f"{usage:.0%} >= threshold {threshold:.0%} "
-                      f"(newest-lease-first policy)")
             try:
-                victim.proc.kill()
+                samples = self._worker_samples()
+                usage = self._memory_usage_fraction(samples)
+                if usage is not None:
+                    self._last_pressure = usage
+                    pressure_gauge.set(usage)
+                victim = watchdog.tick(usage, samples)
+                if victim is None:
+                    continue
+                oom_kills.inc(tags={"reason": "node_pressure"})
+                await self._oom_kill(victim, usage, samples)
             except Exception:
-                pass
-            self._on_worker_dead(victim.worker_id, reason)
+                pass  # the watchdog must survive any single bad tick
+
+    async def _oom_kill(self, victim, usage: float, samples: List) -> None:
+        """Execute one watchdog kill: receipt to the owner first (its
+        own connection — best-effort, ordered ahead of the worker-socket
+        reset it is about to observe), then SIGKILL, then the normal
+        death bookkeeping (which reports to the head with the receipt
+        attached for poison-task accounting)."""
+        w = self._workers.get(victim.worker_id)
+        if w is None or w.proc.poll() is not None:
+            return
+        receipt = self._oom_receipt(victim, usage, samples)
+        lease = self._leases.get(w.lease_id) if w.lease_id else None
+        if lease is not None and lease.owner_conn is not None \
+                and not lease.owner_conn.writer.is_closing():
+            try:
+                await lease.owner_conn.push("oom_kill", receipt)
+            except Exception:
+                pass  # owner gone: the generic death path still covers it
+        reason = (f"OOM-killed by the memory monitor: node memory "
+                  f"{usage:.0%} >= threshold {receipt['threshold']:.0%}, "
+                  f"worker RSS {victim.rss >> 20} MiB "
+                  f"(victim policy: highest-RSS retriable task)")
+        self._oom_reported[victim.worker_id] = receipt
+        try:
+            w.proc.kill()
+        except Exception:
+            pass
+        self._on_worker_dead(victim.worker_id, reason)
+
+    async def rpc_worker_flags(self, worker_id: str,
+                               pinned: Optional[bool] = None,
+                               saving: Optional[bool] = None):
+        """Worker-pushed OOM-policy flags: entering/leaving a pinned
+        __rt_dag_* loop, and the __rt_save__ critical section."""
+        w = self._workers.get(worker_id)
+        if w is not None:
+            if pinned is not None:
+                w.pinned = bool(pinned)
+            if saving is not None:
+                w.saving = bool(saving)
+        return {"ok": True}
 
     async def _report_worker_death(self, worker_id: str, reason: str):
+        oom = self._oom_reported.pop(worker_id, None)
         try:
             await self._head.call("worker_died", node_id=self.node_id,
-                                  worker_id=worker_id, reason=reason)
+                                  worker_id=worker_id, reason=reason,
+                                  oom=oom)
         except Exception:
             pass
 
@@ -1383,6 +1646,13 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         """
         ts = TaskSpec.from_wire(spec)
         demand = ts.resource_set()
+        poisoned = self._quarantined_entry(ts.function_id)
+        if poisoned is not None:
+            # fail fast BEFORE spending a worker: the class already
+            # killed workers poison_task_threshold consecutive times
+            return {"error": "poisoned",
+                    "error_str": poisoned.get("detail", "quarantined"),
+                    "history": poisoned.get("history", [])}
         if self._draining:
             # owners treat this as a retriable lease timeout; by their
             # next ask the drained cluster view routes them elsewhere
@@ -1432,6 +1702,15 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         labels = {nid: v.get("labels", {})
                   for nid, v in self.cluster_view.items()}
         labels[self.node_id] = self.labels
+        # pressure-aware demotion: nodes past the watchdog threshold
+        # (gossiped gauge; our own sample is fresher) rank behind
+        # healthy ones, so new work stops piling onto a node whose
+        # watchdog is about to start killing
+        pressure = {nid: float(v["pressure"])
+                    for nid, v in self.cluster_view.items()
+                    if v.get("pressure") is not None}
+        if self._last_pressure is not None:
+            pressure[self.node_id] = self._last_pressure
         target = pick_node(
             cluster, demand, self.node_id,
             spread_threshold=config.scheduler_spread_threshold,
@@ -1439,7 +1718,9 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
             top_k_absolute=config.scheduler_top_k_absolute,
             strategy=ts.scheduling_strategy, labels_by_node=labels,
             arg_bytes_by_node=self._arg_bytes_by_node(ts),
-            locality_min_bytes=int(config.locality_min_bytes))
+            locality_min_bytes=int(config.locality_min_bytes),
+            pressure_by_node=pressure,
+            pressure_threshold=float(config.memory_usage_threshold))
         if target is None:
             # hard affinity/label constraints name specific nodes;
             # autoscaled capacity can never satisfy them, so they
@@ -1479,6 +1760,11 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         post-reply pump re-asks for the rest."""
         ts = TaskSpec.from_wire(spec)
         demand = ts.resource_set()
+        poisoned = self._quarantined_entry(ts.function_id)
+        if poisoned is not None:
+            return {"error": "poisoned",
+                    "error_str": poisoned.get("detail", "quarantined"),
+                    "history": poisoned.get("history", [])}
         if self._draining:
             await asyncio.sleep(0.2)
             return {"error": "lease timeout", "error_str": "node draining"}
@@ -1801,7 +2087,17 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         lease = _Lease(lease_id, worker, demand, bundle_key,
                        seq=self._lease_counter, owner_conn=conn,
                        owner_id=ts.caller_id if ts is not None else "",
-                       owner_addr=ts.owner_addr if ts is not None else None)
+                       owner_addr=ts.owner_addr if ts is not None else None,
+                       # actors hold their lease for life: killing one is
+                       # an actor death, never a transparent task retry.
+                       # Normal tasks are ALWAYS OOM-retriable — even
+                       # max_retries=0 ones, since watchdog kills draw
+                       # from the separate task_oom_retries budget
+                       retriable=(ts is not None
+                                  and ts.kind == NORMAL_TASK),
+                       fid=ts.function_id if ts is not None else "",
+                       task_name=(ts.name or ts.method_name)
+                       if ts is not None else "")
         n_tpu = int(demand.to_dict().get("TPU", 0))
         take = min(n_tpu, len(self._free_tpu_chips))
         if take > 0:

@@ -41,13 +41,17 @@ def _shard_index(oid: str, num_shards: int) -> int:
 
 
 class _Shard:
-    __slots__ = ("lock", "version", "holders")
+    __slots__ = ("lock", "version", "holders", "crcs")
 
     def __init__(self):
         self.lock = threading.Lock()
         self.version = 0
         # oid -> {node_id: size}
         self.holders: Dict[str, Dict[str, int]] = {}
+        # oid -> seal-fixed CRC32 (checksummed transfers): a property of
+        # the object's bytes, not of any holder — one slot per oid,
+        # dropped with the last holder
+        self.crcs: Dict[str, int] = {}
 
 
 class ShardedObjectDirectory:
@@ -72,16 +76,22 @@ class ShardedObjectDirectory:
         re-send (epoch mismatch): entries this node reported before but
         not now are dropped first, so a desynced agent converges in one
         beat."""
+        added = [list(ent) for ent in added]
         with self._node_lock:
             known = self._node_oids.setdefault(node_id, set())
-            stale = known - {oid for oid, _size in added} if full else set()
+            stale = known - {ent[0] for ent in added} if full else set()
         if stale:
             self._drop_entries(node_id, stale)
         touched: Set[int] = set()
-        for oid, size in added:
+        for ent in added:
+            # [oid, size] (pre-checksum agents) or [oid, size, crc]
+            oid, size = ent[0], ent[1]
+            crc = ent[2] if len(ent) > 2 else None
             shard = self._shard_of(oid)
             with shard.lock:
                 shard.holders.setdefault(oid, {})[node_id] = int(size)
+                if crc is not None:
+                    shard.crcs[oid] = int(crc)
             touched.add(id(shard))
             with self._node_lock:
                 self._node_oids.setdefault(node_id, set()).add(oid)
@@ -101,6 +111,7 @@ class ShardedObjectDirectory:
                 if ent is not None and ent.pop(node_id, None) is not None:
                     if not ent:
                         shard.holders.pop(oid, None)
+                        shard.crcs.pop(oid, None)
                     shard.version += 1
             with self._node_lock:
                 known = self._node_oids.get(node_id)
@@ -119,6 +130,13 @@ class ShardedObjectDirectory:
         shard = self._shard_of(oid)
         with shard.lock:
             return dict(shard.holders.get(oid) or {})
+
+    def checksum(self, oid: str) -> Optional[int]:
+        """The directory-recorded seal CRC32 for oid (None when no
+        checksum-reporting holder has advertised it)."""
+        shard = self._shard_of(oid)
+        with shard.lock:
+            return shard.crcs.get(oid)
 
     def versions(self) -> List[int]:
         return [s.version for s in self._shards]
@@ -204,11 +222,13 @@ class DeltaReporter:
 
     def build(self, summary: List[List[Any]],
               head_epoch: Optional[str]) -> Dict[str, Any]:
-        current = {oid: int(size) for oid, size in summary}
+        # summary entries: [oid, size] or [oid, size, crc]
+        current = {ent[0]: (int(ent[1]), ent[2] if len(ent) > 2 else None)
+                   for ent in summary}
         full = head_epoch is None or head_epoch != self._epoch
         base = {} if full else self._acked
-        added = [[oid, size] for oid, size in current.items()
-                 if base.get(oid) != size]
+        added = [[oid, size, crc] for oid, (size, crc) in current.items()
+                 if base.get(oid) != (size, crc)]
         removed = [oid for oid in base if oid not in current]
         self._pending = (current, head_epoch)
         return {"add": added, "remove": removed, "full": full,

@@ -31,6 +31,7 @@ import mmap
 import os
 import sys
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
@@ -195,6 +196,12 @@ class _Entry:
     path: str = ""  # disk only
     sealed: bool = False
     primary: bool = True
+    # CRC32 of the payload, fixed at seal (bytes are immutable after)
+    # and computed lazily on first export (object_checksums): carried in
+    # directory entries and the transfer control protocol, verified by
+    # pullers — detects post-seal/in-transit corruption end to end.
+    # None for channels (mutable) and when checksums are disabled.
+    crc: Optional[int] = None
     # reusable pinned channel slot (compiled-DAG channels): permanently
     # pinned, never spilled/evicted, excluded from the object directory,
     # and writable in place after seal (single-writer ring discipline is
@@ -231,11 +238,20 @@ class StoreCore:
         self._deleted: Set[str] = set()  # freed oids: get() fails fast
         self.num_spilled = 0
         self.num_evicted = 0
+        # put()-backpressure wakeup: set whenever bytes free (pin
+        # release, drop, free) so a create waiting for shm room retries
+        # event-driven instead of polling (created lazily — __init__
+        # may run without a loop)
+        self._room_event: Optional[asyncio.Event] = None
 
     # ---- lifecycle -------------------------------------------------------
 
-    def create(self, oid: str, size: int, primary: bool = True) -> Dict[str, Any]:
-        """Reserve space for oid. Returns {"location","offset"|"path"}."""
+    def create(self, oid: str, size: int, primary: bool = True,
+               no_disk_fallback: bool = False) -> Dict[str, Any]:
+        """Reserve space for oid. Returns {"location","offset"|"path"}.
+        ``no_disk_fallback`` raises ObjectStoreFull instead of spilling
+        the create to a disk file when shm cannot fit it right now —
+        the put-backpressure wait path probes with it."""
         if oid in self.objects:
             raise ObjectAlreadyExists(oid)
         self._deleted.discard(oid)
@@ -248,6 +264,10 @@ class StoreCore:
                 self.objects[oid] = _Entry(size=size, location="shm", offset=offset,
                                            primary=primary)
                 return {"location": "shm", "offset": offset, "size": size}
+        if no_disk_fallback:
+            raise ObjectStoreFull(
+                f"no shm room for {size} bytes (arena "
+                f"{self.alloc.capacity - self.alloc.allocated} free)")
         # fallback to disk (reference: plasma fallback allocation)
         path = os.path.join(self.spill_dir, f"obj-{oid}")
         with open(path, "wb") as f:
@@ -255,6 +275,55 @@ class StoreCore:
         self.objects[oid] = _Entry(size=size, location="disk", path=path,
                                    primary=primary)
         return {"location": "disk", "path": path, "size": size}
+
+    def _wake_room_waiters(self) -> None:
+        if self._room_event is not None:
+            self._room_event.set()
+
+    def room_may_free(self, size: int) -> bool:
+        """Whether waiting could ever get `size` into shm: the object
+        fits the arena at all, and bytes exist that CAN free — pinned
+        entries (pins release), unsealed creates (they seal or abort),
+        or freed-but-pinned leftovers.  When everything resident is
+        unpinned+sealed, _reclaim already did its best and waiting is
+        pointless."""
+        if size > self.arena.size:
+            return False
+        for oid, e in self.objects.items():
+            if e.location != "shm" or e.channel:
+                continue
+            if e.pinned or not e.sealed or oid in self._deleted:
+                return True
+        return False
+
+    async def create_with_backpressure(self, oid: str, size: int,
+                                       primary: bool = True,
+                                       wait_s: float = 0.0) -> Dict[str, Any]:
+        """create(), but a put that would fall to DISK only because the
+        arena is transiently full of pinned/unsealed bytes blocks up to
+        ``wait_s`` (the client bounds this by its ambient deadline) for
+        room to free — backpressure instead of silently flooding the
+        slow path.  After the wait (or when nothing can free) the
+        normal create semantics apply: disk fallback, and only a truly
+        unservable create raises."""
+        deadline = time.monotonic() + max(0.0, wait_s)
+        while True:
+            try:
+                return self.create(oid, size, primary=primary,
+                                   no_disk_fallback=True)
+            except ObjectStoreFull:
+                pass
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not self.room_may_free(size):
+                return self.create(oid, size, primary=primary)
+            if self._room_event is None:
+                self._room_event = asyncio.Event()
+            self._room_event.clear()
+            try:
+                await asyncio.wait_for(self._room_event.wait(),
+                                       min(remaining, 0.25))
+            except asyncio.TimeoutError:
+                pass  # re-probe: a pin may have released without a wake
 
     def create_channel(self, oid: str, size: int) -> Dict[str, Any]:
         """Reserve a reusable pinned shm slot for a compiled-DAG channel
@@ -307,6 +376,56 @@ class StoreCore:
         ev = self._seal_events.pop(oid, None)
         if ev is not None:
             ev.set()
+
+    def compute_crc(self, entry: _Entry) -> Optional[int]:
+        """CRC32 of an entry's current payload bytes (None when
+        checksums are disabled or the bytes are unreadable).  zlib.crc32
+        runs ~1 GB/s+ in C; the directory floor (locality_min_bytes)
+        keeps the entries that matter largest, and every byte hashed
+        here is a byte a pull can verify later."""
+        from ray_tpu._private.config import config
+
+        if not config.object_checksums:
+            return None
+        try:
+            if entry.location == "shm":
+                return zlib.crc32(
+                    self.arena.view[entry.offset:entry.offset + entry.size])
+            crc = 0
+            with open(entry.path, "rb") as f:
+                while True:
+                    chunk = f.read(8 * 1024 * 1024)
+                    if not chunk:
+                        return crc
+                    crc = zlib.crc32(chunk, crc)
+        except OSError:
+            return None
+
+    def verify_crc(self, oid: str) -> Optional[bool]:
+        """Re-hash a sealed local copy against its seal-time checksum:
+        True = intact, False = CORRUPT, None = unverifiable (no stored
+        crc / checksums off / not sealed here).  The corrupt-copy
+        quarantine path runs this when a puller reports a mismatch."""
+        entry = self.objects.get(oid)
+        if entry is None or not entry.sealed or entry.crc is None:
+            return None
+        current = self.compute_crc(entry)
+        if current is None:
+            return None
+        return current == entry.crc
+
+    def checksum(self, oid: str) -> Optional[int]:
+        """The seal-fixed CRC32 of a sealed object, computed lazily on
+        first export (obj_info / directory summary) and cached — the
+        bytes are immutable from seal, so hashing at first use is
+        equivalent to hashing at seal while keeping the local put hot
+        path at memcpy speed."""
+        entry = self.objects.get(oid)
+        if entry is None or not entry.sealed or entry.channel:
+            return None
+        if entry.crc is None:
+            entry.crc = self.compute_crc(entry)
+        return entry.crc
 
     def abort(self, oid: str) -> None:
         """Abort an unsealed create (client died mid-write)."""
@@ -381,6 +500,7 @@ class StoreCore:
         n = entry.pins.get(client_id, 0)
         if n <= 1:
             entry.pins.pop(client_id, None)
+            self._wake_room_waiters()  # pinned bytes became reclaimable
         else:
             entry.pins[client_id] = n - 1
 
@@ -388,6 +508,20 @@ class StoreCore:
         """Drop all pins held by a disconnected client (worker death)."""
         for entry in self.objects.values():
             entry.pins.pop(client_id, None)
+        self._wake_room_waiters()
+
+    def drop_copy(self, oid: str) -> bool:
+        """Evict ONE local copy without owner-delete semantics (unlike
+        free, which marks the oid deleted so local getters fail with
+        "freed"): used for a corrupt local copy — the owner's ref and
+        other nodes' copies stay valid, and local getters simply see
+        not-local and pull afresh.  Pinned copies are left in place (a
+        reader may be mid-access of the bytes)."""
+        entry = self.objects.get(oid)
+        if entry is None or entry.pinned or entry.channel:
+            return False
+        self._drop(oid, entry)
+        return True
 
     def free(self, oids: List[str]) -> None:
         """Owner-driven delete. Pinned objects are dropped once unpinned."""
@@ -458,10 +592,17 @@ class StoreCore:
         (locality-aware spillback + multi-source pull retry).  Largest
         first, so the cap drops the entries that matter least.
         min_bytes <= 0 means locality is disabled: report nothing
-        rather than every tiny object."""
+        rather than every tiny object.  Entries carry the seal-fixed
+        CRC32 when ALREADY computed (a pull/obj_info hashed it) — the
+        directory picks checksums up opportunistically rather than this
+        heartbeat-path walk hashing gigabytes on the agent loop; pull
+        verification itself always gets a fresh crc from the holder's
+        obj_info handshake, where the hash cost amortizes into the
+        transfer."""
         if min_bytes <= 0:
             return []
-        out = [[oid, e.size] for oid, e in self.objects.items()
+        out = [[oid, e.size, e.crc]
+               for oid, e in self.objects.items()
                if e.sealed and e.size >= min_bytes and not e.channel
                and oid not in self._deleted]
         if len(out) > limit:
@@ -495,6 +636,7 @@ class StoreCore:
             ev.set()
         if entry.location == "shm":
             self.alloc.free(entry.offset, entry.size)
+            self._wake_room_waiters()
         else:
             try:
                 os.unlink(entry.path)
@@ -667,10 +809,13 @@ class PlasmaClient:
         _native.touch_pages_write(view)
 
     def put_serialized(self, oid: str, frames, total_size: int,
-                       primary: bool = True) -> None:
+                       primary: bool = True, wait_s: float = 0.0) -> None:
         from ray_tpu._private import serialization
 
-        loc = self.rpc.call("store_create", oid=oid, size=total_size, primary=primary)
+        loc = self.rpc.call("store_create", oid=oid, size=total_size,
+                            primary=primary,
+                            **({"wait_s": wait_s, "timeout": wait_s + 60.0}
+                               if wait_s > 0 else {}))
         try:
             if loc["location"] == "shm":
                 out = self.arena.view[loc["offset"]:loc["offset"] + total_size]
@@ -687,8 +832,12 @@ class PlasmaClient:
             raise
         self.rpc.call("store_seal", oid=oid)
 
-    def put_raw(self, oid: str, data: bytes, primary: bool = True) -> None:
-        loc = self.rpc.call("store_create", oid=oid, size=len(data), primary=primary)
+    def put_raw(self, oid: str, data: bytes, primary: bool = True,
+                wait_s: float = 0.0) -> None:
+        loc = self.rpc.call("store_create", oid=oid, size=len(data),
+                            primary=primary,
+                            **({"wait_s": wait_s, "timeout": wait_s + 60.0}
+                               if wait_s > 0 else {}))
         try:
             if loc["location"] == "shm":
                 from ray_tpu import _native
@@ -812,19 +961,22 @@ class RpcPlasmaClient(PlasmaClient):
         self.client_id = client_id
 
     def put_serialized(self, oid: str, frames, total_size: int,
-                       primary: bool = True) -> None:
+                       primary: bool = True, wait_s: float = 0.0) -> None:
         from ray_tpu._private import serialization
 
         buf = bytearray(total_size)
         serialization.pack_into(frames, memoryview(buf))
-        self.put_raw(oid, buf, primary=primary)
+        self.put_raw(oid, buf, primary=primary, wait_s=wait_s)
 
-    def put_raw(self, oid: str, data, primary: bool = True) -> None:
+    def put_raw(self, oid: str, data, primary: bool = True,
+                wait_s: float = 0.0) -> None:
         # memoryview slices: no per-chunk copies (msgpack serializes any
         # buffer-protocol object directly)
         view = memoryview(data)
         self.rpc.call("store_create", oid=oid, size=view.nbytes,
-                      primary=primary)
+                      primary=primary,
+                      **({"wait_s": wait_s, "timeout": wait_s + 60.0}
+                         if wait_s > 0 else {}))
         try:
             for pos in range(0, view.nbytes, self._CHUNK):
                 reply = self.rpc.call(

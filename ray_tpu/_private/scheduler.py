@@ -105,6 +105,8 @@ def pick_node(
     labels_by_node: Optional[Dict[str, Dict[str, str]]] = None,
     arg_bytes_by_node: Optional[Dict[str, float]] = None,
     locality_min_bytes: int = 0,
+    pressure_by_node: Optional[Dict[str, float]] = None,
+    pressure_threshold: float = 1.0,
 ) -> Optional[str]:
     """Hybrid policy: choose the node to send a lease request to.
 
@@ -136,9 +138,24 @@ def pick_node(
       {"type": "node_label", "hard": {k: v}} — restrict to nodes whose
         labels match, then run the default policy
         (node_label_scheduling_policy.cc)
+
+    ``pressure_by_node`` (node memory usage fraction, from the agents'
+    watchdog samples riding heartbeats) demotes nodes at/above
+    ``pressure_threshold``: while ANY under-pressure node can fit the
+    demand, the over-pressure ones are removed from consideration — new
+    work stops landing where the OOM watchdog is about to kill.  Hard
+    placement constraints (node_affinity, node_label) and the
+    no-alternative case still use the full set: a pressured node beats
+    no node.
     """
     rng = rng or random
     stype = (strategy or {}).get("type", "")
+    if (pressure_by_node and stype in ("", "spread")
+            and pressure_threshold < 1.0):
+        calm = {nid: nr for nid, nr in cluster.items()
+                if pressure_by_node.get(nid, 0.0) < pressure_threshold}
+        if calm and any(nr.can_fit(demand) for nr in calm.values()):
+            cluster = calm
     if stype == "node_affinity":
         target = strategy.get("node_id", "")
         node = cluster.get(target)

@@ -32,6 +32,7 @@ import contextvars
 import inspect
 import os
 import queue
+import random
 import sys
 import threading
 import time
@@ -48,6 +49,7 @@ from ray_tpu._private.errors import (TaskCancelledError,
                                      ActorDiedError, DeadlineExceededError,
                                      GetTimeoutError,
                                      ObjectFreedError, ObjectLostError,
+                                     OutOfMemoryError, PoisonedTaskError,
                                      RayTaskError, RayWorkerError,
                                      RuntimeEnvSetupError, SchedulingError)
 from ray_tpu._private.function_manager import FunctionManager
@@ -68,6 +70,14 @@ from ray_tpu._private.task_spec import (ACTOR_CREATION_TASK, ACTOR_TASK,
 
 MODE_DRIVER = "driver"
 MODE_WORKER = "worker"
+
+# owner-local poison-quarantine cache window: fail-fast verdicts learned
+# from kill reports / refused leases are honored at most this long
+# before the next submission re-validates through the lease layer — a
+# `rtpu quarantine clear` becomes effective cluster-wide within one
+# window + a heartbeat, while the fail-fast still never churns workers
+# (the lease refusal is a cheap RPC, not a spawn)
+_POISON_CACHE_S = 5.0
 
 # MPMD pipeline-stage system methods (train/pipeline.py): named with the
 # "__rt_dag_" prefix so they ride the compiled-DAG dispatch branch in
@@ -174,12 +184,20 @@ _exec_ctx = contextvars.ContextVar("rt_exec_shadow", default=None)
 
 class _TaskState:
     __slots__ = ("spec", "contained_refs", "retries_left", "sched_key",
-                 "return_oids", "deps_ready", "cancelled", "defer_deadline")
+                 "return_oids", "deps_ready", "cancelled", "defer_deadline",
+                 "oom_retries_left", "oom_attempt", "oom_delay")
 
     def __init__(self, spec: TaskSpec, contained_refs: List[ObjectRef]):
         self.spec = spec
         self.contained_refs = contained_refs
         self.retries_left = spec.max_retries
+        # watchdog OOM kills draw from their own budget — they must
+        # never silently consume max_retries (the kill was the system's
+        # choice, not the task's fault), and the jittered exponential
+        # backoff below gives pressure time to clear between attempts
+        self.oom_retries_left = int(config.task_oom_retries)
+        self.oom_attempt = 0
+        self.oom_delay = 0.0  # next requeue delay, consumed by the pusher
         self.sched_key = spec.scheduling_class()
         self.deps_ready = True
         self.cancelled = False  # ray_tpu.cancel hit it mid-resolution
@@ -515,6 +533,19 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
         # normal tasks whose ref args are still resolving (not yet in any
         # pending queue) — cancellable through here
         self._resolving_tasks: Dict[str, _TaskState] = {}
+        # memory-pressure resilience (memory_monitor.py + head.py
+        # quarantine): watchdog kill receipts pushed by agents keyed by
+        # the killed worker_id (consulted when the worker connection's
+        # death surfaces — a receipt turns a generic RayWorkerError into
+        # a typed, separately-budgeted OutOfMemoryError); the local
+        # poison-quarantine cache (fid -> (until, detail, history))
+        # learned from kill-report replies / poisoned lease refusals;
+        # and the fids this owner has reported kills for (their first
+        # later success sends the ok-report that resets the head's
+        # consecutive-kill count)
+        self._oom_receipts: Dict[str, Dict[str, Any]] = {}
+        self._quarantined: Dict[str, tuple] = {}
+        self._kill_history: Set[str] = set()
         # end-to-end deadlines (_private/deadlines.py): the sweep timer
         # runs only while deadlined tasks exist (armed at submit, self-
         # re-arming while it finds any); _deadline_resolved marks tasks
@@ -887,6 +918,20 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
         if method == "log_lines":
             self._print_log_lines(payload)
             return
+        if method == "oom_kill":
+            # watchdog kill receipt, sent just BEFORE the SIGKILL: when
+            # the worker connection's death surfaces in the push path,
+            # the receipt reclassifies it as an OOM kill (typed error,
+            # separate retry budget).  Bounded: receipts are consumed on
+            # the death they explain; prune oldest if one never is
+            # (owner_conn raced a reconnect and the death was seen by a
+            # different owner object)
+            wid = payload.get("worker_id", "")
+            if wid:
+                self._oom_receipts[wid] = payload
+                while len(self._oom_receipts) > 256:
+                    self._oom_receipts.pop(next(iter(self._oom_receipts)))
+            return
         if method == "reclaim_idle_leases":
             # demand queued behind our leases on THAT agent: hand back
             # warm-pool leases NOW instead of after the TTL sweep.  The
@@ -1229,7 +1274,17 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             self.memory.set_raw(oid, bytes(buf))
             node_addr = None
         else:
-            self.plasma.put_serialized(oid, frames, size, primary=True)
+            # backpressure: a put the arena cannot take right now blocks
+            # (bounded by the ambient deadline and put_backpressure_max_s)
+            # for pinned bytes to release instead of silently flooding
+            # the disk-fallback path; a truly unspillable arena still
+            # falls through to the store's normal create semantics
+            wait_s = float(config.put_backpressure_max_s)
+            remaining = deadlines.remaining(deadlines.current_deadline())
+            if remaining is not None:
+                wait_s = min(wait_s, max(0.0, remaining))
+            self.plasma.put_serialized(oid, frames, size, primary=True,
+                                       wait_s=wait_s)
             self._locations[oid] = self.agent_addr
             self._obj_sizes[oid] = size
             node_addr = self.agent_addr
@@ -1884,7 +1939,31 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             state.pump_queued = False
         self._pump(state)
 
+    def _fail_poisoned(self, state: _SchedState, spec: TaskSpec,
+                       reply: Dict[str, Any]) -> None:
+        """An agent refused this class's lease because the head
+        quarantined it as poison: cache the verdict locally (later
+        submissions fail before any RPC) and fail every pending task in
+        the class fast with the typed error + kill history."""
+        detail = reply.get("error_str", "task class is quarantined")
+        history = list(reply.get("history", []))
+        until = time.time() + _POISON_CACHE_S
+        if spec.function_id:
+            self._quarantined[spec.function_id] = (until, detail, history)
+        err = PoisonedTaskError(detail, key=spec.function_id,
+                                history=history)
+        while state.pending:
+            self._fail_task(state.pending.popleft(), err)
+
     async def _submit(self, task: _TaskState):
+        q = self._fid_quarantined(task.spec.function_id)
+        if q is not None:
+            # fail fast at submission: the class is quarantined as
+            # poison (this owner learned it from a kill report or a
+            # refused lease); dispatching would only be refused again
+            self._fail_task(task, PoisonedTaskError(
+                q[1], key=task.spec.function_id, history=q[2]))
+            return
         # owner-side dependency resolution (reference: dependency_resolver.h)
         # — registered so ray_tpu.cancel can reach a task whose args are
         # still resolving (it is in no pending queue yet)
@@ -2533,6 +2612,9 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                     while state.pending:
                         self._fail_task(state.pending.popleft(), err)
                     return
+                if reply.get("error") == "poisoned":
+                    self._fail_poisoned(state, spec, reply)
+                    return
                 if reply.get("error") == "runtime env setup failed":
                     err = RuntimeEnvSetupError(
                         reply.get("error_str", "runtime env setup failed"))
@@ -2601,6 +2683,9 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                     while state.pending:
                         self._fail_task(state.pending.popleft(), err)
                     return
+                if reply.get("error") == "poisoned":
+                    self._fail_poisoned(state, spec, reply)
+                    return
                 if reply.get("error") == "runtime env setup failed":
                     err = RuntimeEnvSetupError(
                         reply.get("error_str", "runtime env setup failed"))
@@ -2663,6 +2748,9 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                 while state.pending:
                     self._fail_task(state.pending.popleft(), err)
                 return
+            if reply.get("error") == "poisoned":
+                self._fail_poisoned(state, spec, reply)
+                return
             if not state.pending:
                 return
 
@@ -2701,8 +2789,12 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                                  timeout=_TASK_PUSH_TIMEOUT)
         except (ConnectionLost, RpcError, Exception) as e:
             self._drop_lease(state, lease, kill=True)
+            # a watchdog kill's receipt rides the agent connection, the
+            # death itself the worker connection: one beat lets an
+            # in-flight receipt land before the death is classified
+            await self._sleep(0.05)
             if self._account_push_death(lease, task, e):
-                await self._sleep(config.task_retry_delay_ms / 1000.0)
+                await self._sleep(self._death_retry_delay([task]))
                 state.pending.appendleft(task)
             self._pump(state)
             return
@@ -2724,6 +2816,8 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
         batched pushes): only the task actually running (oldest in the
         worker's FIFO when it died) is charged a retry; tasks merely
         queued behind it were never started and requeue for free.
+        A watchdog OOM receipt for the dead worker reroutes the charge
+        to the separate OOM budget (typed error when exhausted).
         Returns True if the task should be requeued, False if it was
         resolved (cancelled or failed)."""
         started = lease.failed_head is task
@@ -2733,14 +2827,147 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
             pass
         if self._take_cancelled(task):
             return False
+        if started:
+            receipt = self._oom_receipts.pop(lease.worker_id, None)
+            if receipt is not None:
+                return self._account_oom_death(task, receipt)
         if not started or task.retries_left != 0:
             if started and task.retries_left > 0:
                 task.retries_left -= 1
             return True
+        # TERMINAL crash (whole retry budget burned on worker deaths):
+        # feed the head's poison accounting — classes that reliably
+        # crash workers quarantine like OOM loops do.  Deliberately NOT
+        # counted per-kill: one dead NODE takes every same-class lease
+        # on it at once, and a class that recovers on retry elsewhere
+        # must never read as poison
+        self._report_task_kill(task.spec, "crash")
         self._fail_task(task, RayWorkerError(
             f"worker {lease.worker_id[:8]} died running "
             f"{task.spec.name or task.spec.function_id[:8]}: {error}"))
         return False
+
+    def _account_oom_death(self, task: _TaskState,
+                           receipt: Dict[str, Any]) -> bool:
+        """Charge one watchdog kill against the task's OOM budget.
+        Never touches max_retries.  Exhausted budget (or an already-
+        quarantined class) resolves the task with the typed error built
+        from the receipt; otherwise the task requeues after a jittered
+        exponential backoff (the rpc.backoff_delays shape) bounded by
+        the spec's remaining deadline."""
+        from ray_tpu._private.memory_monitor import is_self_poisoning
+
+        spec = task.spec
+        if is_self_poisoning(int(receipt.get("rss", 0)),
+                             int(receipt.get("limit", 0))):
+            self._report_task_kill(spec, "oom")
+        q = self._fid_quarantined(spec.function_id)
+        if q is not None:
+            self._fail_task(task, PoisonedTaskError(
+                q[1], key=spec.function_id, history=q[2]))
+            return False
+        if task.oom_retries_left == 0:
+            self._fail_task(task, self._oom_error(spec, receipt))
+            return False
+        if task.oom_retries_left > 0:
+            task.oom_retries_left -= 1
+        task.oom_attempt += 1
+        base = config.task_retry_delay_ms / 1000.0
+        cap = config.task_oom_retry_max_backoff_ms / 1000.0
+        ceiling = min(max(base, 1e-3) * (2.0 ** task.oom_attempt), cap)
+        delay = random.uniform(ceiling / 2.0, ceiling)
+        if spec.deadline:
+            remaining = spec.deadline - time.time()
+            if remaining <= 0:
+                self._fail_deadline(task, "queued")
+                return False
+            delay = min(delay, remaining)
+        task.oom_delay = delay
+        return True
+
+    @staticmethod
+    def _oom_error(spec: TaskSpec, receipt: Dict[str, Any]) -> Exception:
+        name = spec.name or spec.method_name or spec.function_id[:8]
+        return OutOfMemoryError(
+            f"task {name!r} was OOM-killed by the memory watchdog on "
+            f"node {receipt.get('node_id', '')[:12]} (worker RSS "
+            f"{int(receipt.get('rss', 0)) >> 20} MiB, node usage "
+            f"{receipt.get('usage', 0.0):.0%} >= threshold "
+            f"{receipt.get('threshold', 0.0):.0%}) and its "
+            f"task_oom_retries budget is exhausted",
+            rss_bytes=int(receipt.get("rss", 0)),
+            node_usage=float(receipt.get("usage", 0.0)),
+            node_id=receipt.get("node_id", ""),
+            worker_id=receipt.get("worker_id", ""),
+            breakdown=receipt.get("breakdown") or {})
+
+    def _fid_quarantined(self, fid: str) -> Optional[tuple]:
+        """The live local-quarantine record for fid, TTL-pruned."""
+        q = self._quarantined.get(fid)
+        if q is None:
+            return None
+        if q[0] and time.time() >= q[0]:
+            self._quarantined.pop(fid, None)
+            return None
+        return q
+
+    def _report_task_kill(self, spec: TaskSpec, kind: str) -> None:
+        """Tell the head this class's execution killed a worker (fire-
+        and-forget from the IO loop); the reply carries the class's
+        quarantine verdict, cached locally so the NEXT submission fails
+        fast without waiting for lease-layer gossip."""
+        fid = spec.function_id
+        if not fid:
+            return
+        self._kill_history.add(fid)
+        name = spec.name or spec.method_name or fid[:8]
+
+        async def _report():
+            try:
+                r = await self.head.aio.call(
+                    "task_kill_report", key=fid, kind=kind, name=name,
+                    node_id=self.node_id)
+            except Exception:
+                return
+            if r.get("quarantined"):
+                until = min(float(r.get("until", 0.0)) or
+                            (time.time() + _POISON_CACHE_S),
+                            time.time() + _POISON_CACHE_S)
+                self._quarantined[fid] = (
+                    until,
+                    r.get("detail", f"task class {name!r} is quarantined"),
+                    list(r.get("history", [])))
+
+        self._spawn(_report())
+
+    def _report_task_ok(self, spec: TaskSpec) -> None:
+        """First success of a class with local kill history: reset the
+        head's consecutive-kill count (fire-and-forget)."""
+        fid = spec.function_id
+        if fid not in self._kill_history:
+            return
+        self._kill_history.discard(fid)
+
+        async def _report():
+            try:
+                await self.head.aio.call("task_ok_report", key=fid)
+            except Exception:
+                pass
+
+        self._spawn(_report())
+
+    @staticmethod
+    def _death_retry_delay(tasks: List[_TaskState]) -> float:
+        """The pre-requeue sleep for a batch of death-requeued tasks:
+        the plain worker-death delay, or the longest OOM backoff any of
+        them was charged (consumed so a later, non-OOM requeue of the
+        same task sleeps normally)."""
+        delay = config.task_retry_delay_ms / 1000.0
+        for t in tasks:
+            if t.oom_delay > 0:
+                delay = max(delay, t.oom_delay)
+                t.oom_delay = 0.0
+        return delay
 
     async def _push_batch(self, state: _SchedState, lease: _Lease,
                           tasks: List[_TaskState]):
@@ -2763,12 +2990,13 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                 tpu_chips=lease.tpu_chips, timeout=_TASK_PUSH_TIMEOUT)
         except (ConnectionLost, RpcError, Exception) as e:
             self._drop_lease(state, lease, kill=True)
+            await self._sleep(0.05)  # let an in-flight OOM receipt land
             requeue = [task for task in tasks
                        if self._batch_pending.pop(task.spec.task_id, None)
                        is not None  # else: result arrived before death
                        and self._account_push_death(lease, task, e)]
             if requeue:
-                await self._sleep(config.task_retry_delay_ms / 1000.0)
+                await self._sleep(self._death_retry_delay(requeue))
                 state.pending.extendleft(reversed(requeue))
             self._pump(state)
             return
@@ -2909,6 +3137,11 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
         # the worker replied normally (e.g. a force-cancel caught the task
         # still queued): the force-death mapping entry is no longer needed
         self._cancelled_tasks.discard(task.spec.task_id)
+        if not reply.get("error"):
+            # a real completion of a class this owner reported kills
+            # for: reset the head's consecutive-kill count (the poison
+            # quarantine counts CONSECUTIVE kills by design)
+            self._report_task_ok(task.spec)
         for b_oid in reply.get("borrows") or []:
             self.rc.add_borrower(b_oid, worker_addr)
         if reply.get("needs_ack"):
@@ -3417,6 +3650,58 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                 ctypes.c_long(ident), ctypes.py_object(TaskCancelledError))
         return {"ok": True}
 
+    def _maybe_chaos_oom(self, spec: TaskSpec) -> None:
+        """Chaos ``worker.oom`` site (fault_injection.py): an allocation
+        bomb in the EXECUTING worker — real touched pages, so the
+        watchdog's RSS sampling, victim selection, typed receipt, and
+        the owner's OOM-budget retry all exercise end to end.  Growth is
+        stepped so the watchdog (or, unvirtualized, the host's real
+        threshold) catches it mid-climb; the safety valve raises
+        MemoryError rather than hang forever if nothing kills us (the
+        watchdog disabled or the rule armed without one)."""
+        from ray_tpu._private import fault_injection
+
+        chaos = fault_injection.decide(
+            "worker.oom",
+            # keyed by the task's NAME first: rules can target one
+            # function by its qualname without knowing function ids
+            key=spec.name or spec.method_name or spec.function_id)
+        if chaos is None or chaos.action != "oom":
+            return
+        # grow to just past the watchdog trigger, then park awaiting the
+        # kill: under a virtual node envelope
+        # (memory_monitor_node_total_bytes) this worker's RSS alone
+        # crosses the threshold, so tests/bench never stress the real
+        # host; without one the 4 GiB cap bounds the damage
+        total = int(config.memory_monitor_node_total_bytes)
+        threshold = float(config.memory_usage_threshold)
+        target = min(int(total * threshold) + (64 << 20) if total > 0
+                     else 4 << 30, 4 << 30)
+        hoard = []
+        step = 32 * 1024 * 1024
+        while len(hoard) * step < target:
+            hoard.append(b"\x01" * step)  # touched pages: real RSS
+            time.sleep(0.02)  # let the watchdog sample mid-climb
+        deadline = time.time() + 60.0
+        while time.time() < deadline:  # the SIGKILL ends this park
+            time.sleep(0.25)
+        del hoard
+        raise MemoryError(
+            "chaos worker.oom bomb reached its allocation target but "
+            "was never killed — is the memory watchdog disabled?")
+
+    async def rpc_chaos_rules(self, rules: Optional[List] = None,
+                              version: Optional[int] = None):
+        """Agent-forwarded chaos rule set (fault_injection.py): installs
+        the gossiped rules in THIS worker process so worker-side sites
+        (worker.oom, rpc.*) fire here, including for workers that were
+        already running when the rules were armed."""
+        from ray_tpu._private import fault_injection
+
+        if config.chaos_enabled:
+            fault_injection.install(rules or [], version)
+        return {"ok": True}
+
     async def rpc_chaos_stall(self, duration_s: float = 1.0):
         """Chaos ``worker.stall`` site (fault_injection.py): busy-hang
         this process's RPC IO loop for ``duration_s``.  Deliberately a
@@ -3689,44 +3974,53 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                     # compiled-DAG system methods (dag/execution.py):
                     # the exec loop PINS this exec thread — it blocks on
                     # its input channels and replays the actor's bound
-                    # methods until the graph is torn down
+                    # methods until the graph is torn down.  Flagged to
+                    # the agent so the OOM watchdog treats this worker
+                    # as a last-resort victim (killing it tears down the
+                    # whole graph/pipeline/engine, not one task)
                     from ray_tpu.dag import execution as _dag_exec
 
-                    if spec.method_name == _dag_exec.DAG_INFO_METHOD:
-                        value = _dag_exec.collect_node_info(self)
-                    elif spec.method_name == _dag_exec.DAG_EXEC_METHOD:
-                        value = _dag_exec.run_actor_loop(
-                            self, self._actor_instance, *args)
-                    elif spec.method_name in (PIPELINE_EXEC_METHOD,
-                                              PIPELINE_CTL_METHOD):
-                        # MPMD pipeline stage loop / control ops
-                        # (train/pipeline.py): the loop pins this exec
-                        # thread for the whole training run, like the
-                        # compiled-DAG loop above
-                        from ray_tpu.train import pipeline as _pipe
+                    self._push_worker_flags(pinned=True)
+                    try:
+                        if spec.method_name == _dag_exec.DAG_INFO_METHOD:
+                            value = _dag_exec.collect_node_info(self)
+                        elif spec.method_name == _dag_exec.DAG_EXEC_METHOD:
+                            value = _dag_exec.run_actor_loop(
+                                self, self._actor_instance, *args)
+                        elif spec.method_name in (PIPELINE_EXEC_METHOD,
+                                                  PIPELINE_CTL_METHOD):
+                            # MPMD pipeline stage loop / control ops
+                            # (train/pipeline.py): the loop pins this
+                            # exec thread for the whole training run,
+                            # like the compiled-DAG loop above
+                            from ray_tpu.train import pipeline as _pipe
 
-                        if spec.method_name == PIPELINE_EXEC_METHOD:
-                            value = _pipe.run_stage_loop(
+                            if spec.method_name == PIPELINE_EXEC_METHOD:
+                                value = _pipe.run_stage_loop(
+                                    self, self._actor_instance, *args)
+                            else:
+                                value = _pipe.run_stage_ctl(
+                                    self, self._actor_instance, *args)
+                        elif spec.method_name == LLM_EXEC_METHOD:
+                            # LLM serving decode loop (serve/llm.py):
+                            # pins this exec thread to the replica
+                            # engine's continuous-batching step loop
+                            from ray_tpu.serve import llm as _serve_llm
+
+                            value = _serve_llm.run_llm_loop(
                                 self, self._actor_instance, *args)
                         else:
-                            value = _pipe.run_stage_ctl(
-                                self, self._actor_instance, *args)
-                    elif spec.method_name == LLM_EXEC_METHOD:
-                        # LLM serving decode loop (serve/llm.py): pins
-                        # this exec thread to the replica engine's
-                        # continuous-batching step loop
-                        from ray_tpu.serve import llm as _serve_llm
-
-                        value = _serve_llm.run_llm_loop(
-                            self, self._actor_instance, *args)
-                    else:
-                        raise AttributeError(
-                            f"unknown compiled-DAG system method "
-                            f"{spec.method_name!r}")
+                            raise AttributeError(
+                                f"unknown compiled-DAG system method "
+                                f"{spec.method_name!r}")
+                    finally:
+                        self._push_worker_flags(pinned=False)
                 else:
+                    self._maybe_chaos_oom(spec)
                     fn = getattr(self._actor_instance, spec.method_name)
                     value = fn(*args, **kwargs)
             else:
+                self._maybe_chaos_oom(spec)
                 fn = self.functions.fetch(spec.function_id)
                 value = fn(*args, **kwargs)
             if spec.num_returns == STREAMING:
@@ -3832,10 +4126,14 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                 or spec is None or not spec.actor_id:
             return False
         with self._actor_state_save_lock:
-            ckpt = self._actor_state_checkpoint(spec.actor_id)
-            if ckpt is None:
-                return False
-            ckpt.save(inst.__rt_save__())
+            self._push_worker_flags(saving=True)
+            try:
+                ckpt = self._actor_state_checkpoint(spec.actor_id)
+                if ckpt is None:
+                    return False
+                ckpt.save(inst.__rt_save__())
+            finally:
+                self._push_worker_flags(saving=False)
         return True
 
     def _maybe_save_actor_state(self) -> None:
@@ -3857,12 +4155,31 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                 return
             self._actor_calls_since_save = 0
         with self._actor_state_save_lock:
+            # marked mid-save for the OOM watchdog: killing a worker
+            # inside __rt_save__ risks a torn/partial snapshot, so the
+            # victim policy takes it only as a last resort
+            self._push_worker_flags(saving=True)
             try:
                 ckpt = self._actor_state_checkpoint(spec.actor_id)
                 if ckpt is not None:
                     ckpt.save(inst.__rt_save__())
             except Exception:
                 traceback.print_exc()  # snapshot loss, not call failure
+            finally:
+                self._push_worker_flags(saving=False)
+
+    def _push_worker_flags(self, pinned: Optional[bool] = None,
+                           saving: Optional[bool] = None) -> None:
+        """Best-effort OOM-policy flags to our node agent (worker mode
+        only): pinned-loop and mid-__rt_save__ workers are last-resort
+        watchdog victims."""
+        if self.mode != MODE_WORKER:
+            return
+        try:
+            self.agent.oneway("worker_flags", worker_id=self.worker_id,
+                              pinned=pinned, saving=saving)
+        except Exception:
+            pass  # the agent may be restarting; flags are advisory
 
     def _stream_out(self, spec: TaskSpec, value: Any,
                     conn) -> Dict[str, Any]:

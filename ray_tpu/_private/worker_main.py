@@ -37,6 +37,22 @@ def main():
     worker = CoreWorker(MODE_WORKER, head, agent, arena, node_id,
                         worker_id=worker_id, job_id=JobID.nil().hex())
     set_global_worker(worker)
+    # chaos rules active when this worker was spawned (the agent stamps
+    # them into the env): worker-side sites (worker.oom, rpc.*) fire in
+    # THIS process too, not just in daemons.  Later rule changes reach
+    # running workers via the agent's chaos_rules forward.
+    rules = os.environ.get("RT_CHAOS_RULES")
+    if rules:
+        import json
+
+        from ray_tpu._private import fault_injection
+
+        try:
+            payload = json.loads(rules)
+            fault_injection.install(payload.get("rules", []),
+                                    payload.get("version"))
+        except Exception:
+            pass
     reply = worker.agent.call("worker_ready", worker_id=worker_id,
                               port=worker.address[1])
     if not reply.get("ok"):

@@ -16,6 +16,11 @@ from ray_tpu._private.errors import RayError
 from ray_tpu._private.object_ref import ObjectRef
 
 _state_lock = threading.RLock()
+# RT_* env vars exported by init(_system_config=...) -> their PRIOR
+# value (None = absent before): shutdown() restores rather than pops,
+# so one cluster's overrides never leak into the next AND an
+# operator-exported RT_* setting survives an init/shutdown cycle
+_config_env_prior: Dict[str, Any] = {}
 _global_node: Optional[Dict[str, Any]] = None  # procs + addrs when we own them
 
 
@@ -86,6 +91,12 @@ def init(address: Optional[str] = None, *,
             env = config.deserialize_into_env(config.serialize())
             import os
 
+            # prior values recorded so shutdown() can restore them:
+            # without this a stale RT_* var from one cluster's
+            # _system_config leaks into every LATER cluster's spawned
+            # daemons (env has precedence over fresh overrides)
+            for k in env:
+                _config_env_prior.setdefault(k, os.environ.get(k))
             os.environ.update(env)
         if address is None:
             session_dir = node_mod.new_session_dir()
@@ -158,6 +169,22 @@ def shutdown():
             w.shutdown()
         _renv_cache.clear()
         _teardown_global_node()
+        # _system_config overrides die with the cluster: initialize()
+        # merges into the live override dict and init() exported RT_*
+        # env vars, so without this cleanup a stale key from one init()
+        # (e.g. a test's memory_monitor usage file) silently leaks into
+        # the NEXT cluster's spawned daemons
+        from ray_tpu._private.config import config as _config
+
+        _config._overrides.clear()
+        import os as _os2
+
+        for k, prior in _config_env_prior.items():
+            if prior is None:
+                _os2.environ.pop(k, None)
+            else:
+                _os2.environ[k] = prior
+        _config_env_prior.clear()
 
 
 def put(value: Any) -> ObjectRef:
@@ -237,8 +264,8 @@ class RemoteFunction:
     (reference: python/ray/remote_function.py)."""
 
     _OPT_KEYS = ("num_returns", "num_cpus", "num_gpus", "num_tpus",
-                 "resources", "max_retries", "name", "runtime_env",
-                 "scheduling_strategy", "timeout_s",
+                 "memory", "resources", "max_retries", "name",
+                 "runtime_env", "scheduling_strategy", "timeout_s",
                  "placement_group", "placement_group_bundle_index")
 
     def __init__(self, fn, **opts):
@@ -250,7 +277,8 @@ class RemoteFunction:
         self._num_returns = opts.get("num_returns") or 1
         self._resources = _build_resources(
             opts.get("num_cpus"), opts.get("num_gpus"), opts.get("num_tpus"),
-            opts.get("resources"), default_cpu=1)
+            opts.get("resources"), default_cpu=1,
+            memory=opts.get("memory"))
         self._max_retries = opts.get("max_retries", 3)
         self._name = opts.get("name") or getattr(
             fn, "__qualname__", getattr(fn, "__name__", "fn"))
@@ -329,13 +357,19 @@ def _normalized_renv(handle, w) -> Dict[str, Any]:
 
 
 def _build_resources(num_cpus, num_gpus, num_tpus, resources,
-                     default_cpu: float) -> Dict[str, float]:
+                     default_cpu: float,
+                     memory=None) -> Dict[str, float]:
     out: Dict[str, float] = dict(resources or {})
     out["CPU"] = float(num_cpus) if num_cpus is not None else float(default_cpu)
     if num_gpus is not None:
         out["GPU"] = float(num_gpus)
     if num_tpus is not None:
         out["TPU"] = float(num_tpus)
+    if memory is not None:
+        # bytes, bin-packed against the node's `memory` total (the
+        # watchdog's virtual envelope when configured, else MemTotal) —
+        # declared memory is a real reservation, not a hint
+        out["memory"] = float(memory)
     return out
 
 
@@ -425,7 +459,7 @@ class ActorHandle:
 
 
 class ActorClass:
-    _OPT_KEYS = ("num_cpus", "num_gpus", "num_tpus", "resources",
+    _OPT_KEYS = ("num_cpus", "num_gpus", "num_tpus", "memory", "resources",
                  "max_restarts", "max_task_retries", "max_concurrency",
                  "name", "lifetime", "runtime_env", "scheduling_strategy",
                  "timeout_s",
@@ -442,7 +476,8 @@ class ActorClass:
         # actors coexist on few cores)
         self._resources = _build_resources(
             opts.get("num_cpus"), opts.get("num_gpus"), opts.get("num_tpus"),
-            opts.get("resources"), default_cpu=0)
+            opts.get("resources"), default_cpu=0,
+            memory=opts.get("memory"))
         self._max_restarts = opts.get("max_restarts", 0)
         self._max_task_retries = opts.get("max_task_retries", 0)
         self._max_concurrency = opts.get("max_concurrency", 1)

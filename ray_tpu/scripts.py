@@ -589,6 +589,37 @@ def cmd_chaos(args) -> int:
     return 0
 
 
+def cmd_quarantine(args) -> int:
+    """`rtpu quarantine [list|clear [KEY]]`: inspect and lift the head's
+    poison-task quarantine (classes whose executions OOM-killed or
+    crashed workers poison_task_threshold consecutive times; their
+    submissions fail fast with PoisonedTaskError until the TTL expires
+    or this clears them)."""
+    head, io = _head_client(_resolve_address(args.address))
+    try:
+        if args.quarantine_cmd == "clear":
+            reply = head.call("quarantine", op="clear", key=args.key)
+            print(json.dumps(reply, indent=2))
+            return 0
+        reply = head.call("quarantine", op="list")
+        entries = reply.get("entries", {})
+        if not entries:
+            print("no task classes under quarantine or kill watch")
+            return 0
+        for key, e in sorted(entries.items(),
+                             key=lambda kv: -kv[1]["kills"]):
+            state = (f"QUARANTINED ({e['expires_in_s']}s left)"
+                     if e["quarantined"] else "watching")
+            print(f"{key[:16]:17} {e['name'] or '?':24} "
+                  f"kills={e['kills']:<3} {state}")
+            for h in e.get("history", []):
+                print(f"                  - {h}")
+    finally:
+        head.close()
+        io.stop()
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Inspect distributed traces straight off the head's trace store
     (no driver attach needed — plain head RPCs)."""
@@ -769,6 +800,18 @@ def main(argv=None) -> int:
     csub.add_parser("clear", help="disarm every rule cluster-wide")
     csub.add_parser("status", help="live rule set + firing counts")
     p.set_defaults(fn=cmd_chaos)
+
+    p = sub.add_parser(
+        "quarantine",
+        help="poison-task quarantine: list kill watch, clear entries")
+    p.add_argument("--address", default="")
+    qsub = p.add_subparsers(dest="quarantine_cmd")
+    qsub.add_parser("list", help="kill counts + quarantined classes")
+    qc = qsub.add_parser("clear",
+                         help="lift quarantines now (before the TTL)")
+    qc.add_argument("key", nargs="?", default="",
+                    help="function/class id to clear ('' = all tripped)")
+    p.set_defaults(fn=cmd_quarantine, quarantine_cmd="list")
 
     p = sub.add_parser("trace", help="inspect distributed traces")
     p.add_argument("--address", default="")

@@ -1577,6 +1577,12 @@ class DeploymentHandle:
                 raise  # the budget is gone; retrying cannot help
             except (ActorDiedError, ActorUnavailableError,
                     RayWorkerError):
+                # includes OutOfMemoryError (a RayWorkerError subclass):
+                # a replica OOM-killed by the node memory watchdog reads
+                # as replica death here — _one already fed the breaker a
+                # failure, so repeated OOMs open the circuit and routing
+                # heals away from the starved node while the controller
+                # restarts the replica
                 dead.add(rid)
                 self._drop_replica(rid)
                 if attempt == attempts - 1:

@@ -116,9 +116,11 @@ class TestShardedDirectory:
         assert m.holders("obj") == {}
 
     def test_delta_reporter_epoch_handshake(self):
+        # delta entries are [oid, size, crc] triples since checksummed
+        # transfers (crc None until the store has hashed the object)
         r = DeltaReporter()
         d1 = r.build([["a", 1], ["b", 2]], "epoch1")
-        assert d1["full"] and sorted(oid for oid, _ in d1["add"]) == ["a", "b"]
+        assert d1["full"] and sorted(e[0] for e in d1["add"]) == ["a", "b"]
         r.ack()
         # steady state: no churn -> empty delta
         d2 = r.build([["a", 1], ["b", 2]], "epoch1")
@@ -128,9 +130,13 @@ class TestShardedDirectory:
         d3 = r.build([["a", 1]], "epoch1")
         assert d3["remove"] == ["b"]
         r.ack()
+        # a checksum turning known is churn: the entry re-ships
+        d3b = r.build([["a", 1, 777]], "epoch1")
+        assert not d3b["full"] and d3b["add"] == [["a", 1, 777]]
+        r.ack()
         # head restarted (new epoch): everything re-sends
-        d4 = r.build([["a", 1]], "epoch2")
-        assert d4["full"] and d4["add"] == [["a", 1]]
+        d4 = r.build([["a", 1, 777]], "epoch2")
+        assert d4["full"] and d4["add"] == [["a", 1, 777]]
 
     def test_unacked_delta_is_rebuilt(self):
         """A heartbeat that died in flight must not lose its delta."""
@@ -138,9 +144,9 @@ class TestShardedDirectory:
         r.build([["a", 1]], "e")
         r.ack()
         d = r.build([["a", 1], ["b", 2]], "e")  # not acked (call failed)
-        assert d["add"] == [["b", 2]]
+        assert d["add"] == [["b", 2, None]]
         d = r.build([["a", 1], ["b", 2]], "e")
-        assert d["add"] == [["b", 2]]  # still pending
+        assert d["add"] == [["b", 2, None]]  # still pending
 
 
 # ------------------------------------------------- batched control frames

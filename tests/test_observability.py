@@ -344,7 +344,9 @@ def test_metric_names_documented_in_readme(cluster):
                m.autoscaler_metrics,
                m.serve_sheds_counter,
                m.deadline_metrics,
-               m.serve_tail_metrics):
+               m.serve_tail_metrics,
+               m.memory_pressure_metrics,
+               m.object_checksum_failures_counter):
         fn()
     with m.default_registry._lock:
         names |= set(m.default_registry._metrics)

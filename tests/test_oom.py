@@ -1,0 +1,687 @@
+"""Memory-pressure resilience tests (ISSUE 15).
+
+Fast units for the watchdog policy (fake sampler/clock — no cluster,
+no real memory pressure), typed-error pickle round-trips, quarantine
+protocol units against a live head, the Serve breaker integration, the
+put-backpressure store path, and checksummed-transfer recovery on the
+in-process two-agent harness.  End-to-end kill/retry/quarantine flows
+drive a real cluster through the ``memory_monitor_test_usage_file``
+hook (deterministic pressure, nothing allocated); the chaos
+``worker.oom`` e2e uses the VIRTUAL node envelope
+(``memory_monitor_node_total_bytes``) so a real allocation bomb trips a
+256MB-scale "node" without stressing the host.
+"""
+
+import asyncio
+import os
+import pickle
+import threading
+import time
+import uuid
+from collections import deque
+
+import pytest
+
+import ray_tpu
+from ray_tpu import OutOfMemoryError, PoisonedTaskError
+from ray_tpu._private import memory_monitor
+from ray_tpu._private.config import config
+from ray_tpu._private.errors import RayWorkerError
+
+MB = 1024 * 1024
+
+
+# ------------------------------------------------------- victim policy units
+
+
+def _s(wid, rss, seq=0, retriable=True, pinned=False, saving=False):
+    return memory_monitor.WorkerSample(
+        worker_id=wid, rss=rss, lease_seq=seq, retriable=retriable,
+        pinned=pinned, saving=saving)
+
+
+def test_pick_victim_highest_rss_retriable_first():
+    samples = [_s("small", 10 * MB, seq=1),
+               _s("big", 500 * MB, seq=2),
+               _s("bigger_actor", 900 * MB, seq=3, retriable=False)]
+    # the retriable hog dies before a LARGER non-retriable actor
+    assert memory_monitor.pick_victim(samples).worker_id == "big"
+
+
+def test_pick_victim_last_started_tiebreak():
+    samples = [_s("older", 100 * MB, seq=1), _s("newer", 100 * MB, seq=9)]
+    assert memory_monitor.pick_victim(samples).worker_id == "newer"
+
+
+def test_pick_victim_pinned_and_saving_are_last_resort():
+    samples = [_s("pipeline", 2000 * MB, seq=5, pinned=True),
+               _s("snapshotting", 1500 * MB, seq=4, saving=True),
+               _s("task", 50 * MB, seq=1)]
+    assert memory_monitor.pick_victim(samples).worker_id == "task"
+    # with ONLY pinned/saving workers left they do get picked (the
+    # alternative is the kernel OOM killer taking the whole agent)
+    assert memory_monitor.pick_victim(samples[:2]).worker_id == "pipeline"
+    assert memory_monitor.pick_victim([]) is None
+
+
+def test_pick_victim_non_retriable_before_pinned():
+    samples = [_s("actor", 10 * MB, retriable=False),
+               _s("dag_loop", 900 * MB, pinned=True)]
+    assert memory_monitor.pick_victim(samples).worker_id == "actor"
+
+
+def test_watchdog_threshold_and_kill_gap():
+    clock = [100.0]
+    wd = memory_monitor.OomWatchdog(threshold=0.9, min_kill_gap_s=1.0,
+                                    clock=lambda: clock[0])
+    samples = [_s("w1", 100 * MB), _s("w2", 50 * MB)]
+    assert wd.tick(0.5, samples) is None          # under threshold
+    assert wd.tick(None, samples) is None          # unreadable usage
+    v = wd.tick(0.95, samples)
+    assert v is not None and v.worker_id == "w1"
+    clock[0] += 0.5
+    assert wd.tick(0.99, samples) is None          # inside the kill gap
+    clock[0] += 0.6
+    assert wd.tick(0.99, samples).worker_id == "w1"
+    assert wd.kills == 2
+
+
+def test_self_poisoning_discriminator():
+    # limit unknown (usage-file pressure): every kill counts
+    assert memory_monitor.is_self_poisoning(10 * MB, 0)
+    # aggregate-pressure victim: well under the ceiling, not counted
+    assert not memory_monitor.is_self_poisoning(220 * MB, 435 * MB)
+    # self-poisoning: the victim alone approaches the whole ceiling
+    assert memory_monitor.is_self_poisoning(520 * MB, 435 * MB)
+    assert memory_monitor.is_self_poisoning(int(0.95 * 435 * MB),
+                                            435 * MB)
+
+
+def test_usage_fraction_sources(tmp_path):
+    f = tmp_path / "usage"
+    f.write_text("0.42")
+    assert memory_monitor.usage_fraction(str(f)) == pytest.approx(0.42)
+    # virtual envelope: sum of worker RSS over the configured total
+    assert memory_monitor.usage_fraction(
+        "", 1000, worker_rss_sum=750) == pytest.approx(0.75)
+    # real meminfo on Linux: a sane fraction
+    frac = memory_monitor.usage_fraction("")
+    assert frac is None or 0.0 <= frac <= 1.0
+
+
+# -------------------------------------------------------- typed error units
+
+
+def test_out_of_memory_error_pickle_roundtrip():
+    e = OutOfMemoryError("task killed", rss_bytes=123 * MB,
+                         node_usage=0.97, node_id="n1", worker_id="w1",
+                         breakdown={"workers": [["w1", 123]]})
+    e2 = pickle.loads(pickle.dumps(e))
+    assert isinstance(e2, OutOfMemoryError)
+    assert isinstance(e2, RayWorkerError)  # serve/worker retry filters
+    assert e2.rss_bytes == 123 * MB
+    assert e2.node_usage == pytest.approx(0.97)
+    assert e2.breakdown == {"workers": [["w1", 123]]}
+    assert "task killed" in str(e2)
+
+
+def test_poisoned_task_error_pickle_roundtrip():
+    e = PoisonedTaskError("class quarantined", key="fid123",
+                          history=["oom on node a", "crash on node b"])
+    e2 = pickle.loads(pickle.dumps(e))
+    assert isinstance(e2, PoisonedTaskError)
+    assert e2.key == "fid123"
+    assert e2.history == ["oom on node a", "crash on node b"]
+
+
+# ----------------------------------------------- serve breaker integration
+
+
+def test_replica_oom_feeds_circuit_breaker():
+    """A replica OOM-killed by the watchdog surfaces as
+    OutOfMemoryError — a RayWorkerError subclass, so the handle's
+    dead-replica retry catches it AND each occurrence records a breaker
+    failure; enough of them open the replica's circuit."""
+    from ray_tpu.serve.api import DeploymentHandle
+
+    assert issubclass(OutOfMemoryError, RayWorkerError)
+    h = DeploymentHandle.__new__(DeploymentHandle)
+    h._lock = threading.Lock()
+    h._latencies = deque(maxlen=200)
+    h._lat_version = 0
+    h._p99_cache = None
+    h._name = "d"
+    h._circuits = {}
+    # +1: the time decay between consecutive failures keeps the score a
+    # hair under N after N of them
+    for _ in range(int(config.serve_circuit_fail_threshold) + 1):
+        h._record_outcome("replica-oom", error=True)
+    assert h._circuits["replica-oom"].state == "open"
+
+
+# ------------------------------------------------------ put backpressure
+
+
+def test_put_backpressure_waits_for_pin_release(tmp_path):
+    from ray_tpu._private.object_store import StoreCore
+
+    async def main():
+        store = StoreCore(str(tmp_path / f"arena-{uuid.uuid4().hex[:6]}"),
+                          4 * MB, str(tmp_path / "spill"))
+        # fill the arena with a PINNED sealed object: unspillable right
+        # now, but its pins will release
+        loc = store.create("hog", 3 * MB)
+        store.seal("hog")
+        await store.get(["hog"], "client-a")  # pin
+        assert loc["location"] == "shm"
+
+        async def release_later():
+            await asyncio.sleep(0.3)
+            store.release("hog", "client-a")
+
+        rel = asyncio.ensure_future(release_later())
+        t0 = time.monotonic()
+        out = await store.create_with_backpressure("newobj", 2 * MB,
+                                                   wait_s=10.0)
+        waited = time.monotonic() - t0
+        await rel
+        # blocked until the pin released, then landed in SHM (the pinned
+        # hog spilled to make room) instead of the disk fallback
+        assert out["location"] == "shm", out
+        assert 0.2 <= waited < 5.0, waited
+        store.close()
+
+    asyncio.run(main())
+
+
+def test_put_backpressure_skips_wait_when_nothing_can_free(tmp_path):
+    from ray_tpu._private.object_store import StoreCore
+
+    async def main():
+        store = StoreCore(str(tmp_path / f"arena-{uuid.uuid4().hex[:6]}"),
+                          2 * MB, str(tmp_path / "spill"))
+        t0 = time.monotonic()
+        # larger than the whole arena: waiting can never help — straight
+        # to the disk fallback, no 10s stall
+        out = await store.create_with_backpressure("big", 8 * MB,
+                                                   wait_s=10.0)
+        assert out["location"] == "disk"
+        assert time.monotonic() - t0 < 1.0
+        store.close()
+
+    asyncio.run(main())
+
+
+# -------------------------------------------------- conftest RSS tripwire
+
+
+def test_rss_tripwire_detector_units():
+    import conftest as cft
+
+    # rising RSS floor with flat threads/sockets trips as rss_mb
+    grow = [(f"m{i}", 10, 5, 500 + i * 200) for i in range(10)]
+    hit = cft._monotonic_leak(grow, window=5, floor=25, rss_floor=300)
+    assert hit is not None and hit[0] == "rss_mb"
+    # spikes over a flat baseline never trip
+    spiky = [(f"m{i}", 10, 5, 500 + (1000 if i % 3 == 0 else 0))
+             for i in range(10)]
+    assert cft._monotonic_leak(spiky, window=5, floor=25,
+                               rss_floor=300) is None
+    # slow creep under the floor never trips
+    creep = [(f"m{i}", 10, 5, 500 + i * 20) for i in range(12)]
+    assert cft._monotonic_leak(creep, window=5, floor=25,
+                               rss_floor=300) is None
+    # old 3-tuple snapshots (no rss field) are tolerated
+    legacy = [(f"m{i}", 10, 5) for i in range(10)]
+    assert cft._monotonic_leak(legacy, window=5, floor=25) is None
+    assert cft._read_rss_mb() > 0
+
+
+# ----------------------------------------------- checksummed transfers
+
+
+def _seed(agent, oid, payload, primary=True):
+    loc = agent.store.create(oid, len(payload), primary=primary)
+    if loc["location"] == "shm":
+        agent.store.arena.view[loc["offset"]:loc["offset"] + len(payload)] \
+            = payload
+    else:
+        with open(loc["path"], "r+b") as f:
+            f.write(payload)
+    agent.store.seal(oid)
+
+
+def _read(agent, oid, size):
+    entry = agent.store.objects[oid]
+    if entry.location == "shm":
+        return bytes(agent.store.arena.view[entry.offset:entry.offset + size])
+    with open(entry.path, "rb") as f:
+        return f.read()
+
+
+async def _boot_agents(tmp_path, n=2):
+    from ray_tpu._private.head import HeadService
+    from ray_tpu._private.node_agent import NodeAgent
+
+    head = HeadService()
+    head_port = await head.start()
+    agents = []
+    for i in range(n):
+        ag = NodeAgent(("127.0.0.1", head_port), str(tmp_path), {"CPU": 1},
+                       arena_path=str(
+                           tmp_path / f"arena-{i}-{uuid.uuid4().hex[:6]}"),
+                       capacity=32 * MB)
+        await ag.start()
+        agents.append(ag)
+    return head, agents
+
+
+async def _down(head, agents):
+    for ag in agents:
+        try:
+            await ag.stop()
+        except Exception:
+            pass
+    await head.stop()
+
+
+def test_seal_checksum_and_self_verify(tmp_path):
+    from ray_tpu._private.object_store import StoreCore
+
+    store = StoreCore(str(tmp_path / f"a-{uuid.uuid4().hex[:6]}"), 8 * MB,
+                      str(tmp_path / "spill"))
+    payload = os.urandom(1 * MB)
+    loc = store.create("o1", len(payload))
+    store.arena.view[loc["offset"]:loc["offset"] + len(payload)] = payload
+    store.seal("o1")
+    import zlib
+
+    assert store.checksum("o1") == zlib.crc32(payload)
+    assert store.verify_crc("o1") is True
+    # post-seal bitrot in the arena is detected by re-verification
+    store.arena.view[loc["offset"]] = (payload[0] ^ 0xFF)
+    assert store.verify_crc("o1") is False
+    store.close()
+
+
+def test_corrupt_pull_detected_and_recovers_from_alternate(tmp_path):
+    """`xfer.corrupt` armed: the first pull's payload fails CRC
+    verification (counted, reported to the holder — whose own copy is
+    intact, so it keeps it) and the pull retries from an alternate
+    holder, returning byte-correct data (acceptance criterion)."""
+    from ray_tpu._private import fault_injection
+
+    async def main():
+        head, agents = await _boot_agents(tmp_path, n=3)
+        a, b, c = agents
+        try:
+            payload = os.urandom(2 * MB)
+            _seed(a, "oidx", payload)
+            # second holder so an alternate exists in the head directory
+            r = await b.rpc_ensure_local("oidx", src=[a.host, a.port])
+            assert r.get("ok"), r
+            deadline = time.monotonic() + 10
+            while len(head.dir.locations("oidx")) < 2:
+                assert time.monotonic() < deadline, "directory never saw b"
+                await asyncio.sleep(0.05)
+            assert head.dir.checksum("oidx") is not None
+            fault_injection.inject("xfer.send", "corrupt", count=1,
+                                   target="oidx")
+            r = await c.rpc_ensure_local("oidx")  # holders via directory
+            assert r.get("ok"), r
+            assert _read(c, "oidx", len(payload)) == payload
+            assert c.xfer_stats["checksum_failures"] == 1
+            assert c.xfer_stats["alt_source_retries"] == 1
+            # both original holders keep their (intact) copies
+            assert a.store.contains("oidx") and b.store.contains("oidx")
+        finally:
+            fault_injection.clear()
+            await _down(head, agents)
+
+    asyncio.run(main())
+
+
+def test_corrupt_secondary_copy_is_quarantined(tmp_path):
+    """A holder whose OWN stored secondary copy fails re-verification
+    (real bitrot, not transit corruption) drops it on an obj_corrupt
+    report — the quarantined copy leaves the directory."""
+    async def main():
+        head, agents = await _boot_agents(tmp_path, n=2)
+        a, b = agents
+        try:
+            payload = os.urandom(1 * MB)
+            _seed(b, "oidq", payload, primary=False)
+            b.store.checksum("oidq")  # fix the seal-time crc
+            assert b.store.verify_crc("oidq") is True
+            entry = b.store.objects["oidq"]
+            b.store.arena.view[entry.offset] = payload[0] ^ 0xFF
+            r = await b.rpc_obj_corrupt("oidq")
+            assert r.get("dropped") is True
+            assert not b.store.contains("oidq")
+            # drop_copy evicted the COPY, not owner-freed the oid: a
+            # later local get must read as not-local (pullable), never
+            # as "freed by its owner"
+            locs = await b.store.get(["oidq"], "probe", wait_timeout=0.0)
+            assert locs[0] is None, locs
+            # an intact copy is NOT dropped on a (spurious) report
+            _seed(a, "oidok", payload)
+            a.store.checksum("oidok")
+            r = await a.rpc_obj_corrupt("oidok")
+            assert r.get("dropped") is False and r.get("intact") is True
+            assert a.store.contains("oidok")
+        finally:
+            await _down(head, agents)
+
+    asyncio.run(main())
+
+
+# --------------------------------------------------------- e2e: OOM kills
+
+
+@pytest.fixture
+def oom_cluster(tmp_path):
+    usage_file = str(tmp_path / "usage")
+    with open(usage_file, "w") as f:
+        f.write("0.10")
+    ray_tpu.init(
+        num_cpus=2, object_store_memory=64 * MB,
+        _system_config={
+            "memory_monitor_test_usage_file": usage_file,
+            "memory_usage_threshold": 0.9,
+            "memory_monitor_refresh_ms": 100,
+            "memory_monitor_min_kill_interval_ms": 200,
+            "task_oom_retries": 3,
+            "task_retry_delay_ms": 50,
+            "poison_task_threshold": 2,
+            "poison_task_ttl_s": 60.0,
+        })
+    try:
+        yield usage_file
+    finally:
+        ray_tpu.shutdown()
+
+
+def _wait_for(pred, timeout=30.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_oom_retry_budget_is_separate_from_max_retries(oom_cluster,
+                                                       tmp_path):
+    """max_retries=0 task: a generic worker death would fail it
+    outright — a watchdog OOM kill draws from task_oom_retries instead
+    and the retry succeeds once pressure clears."""
+    usage_file = oom_cluster
+    attempts = str(tmp_path / "attempts")
+
+    @ray_tpu.remote(max_retries=0)
+    def parker():
+        with open(attempts, "a") as f:
+            f.write("x\n")
+        if len(open(attempts).readlines()) == 1:
+            time.sleep(120)  # parked until the watchdog kills us
+        return len(open(attempts).readlines())
+
+    ref = parker.remote()
+    _wait_for(lambda: os.path.exists(attempts), what="first attempt")
+    with open(usage_file, "w") as f:
+        f.write("0.99")
+    _wait_for(lambda: len(open(attempts).readlines()) >= 2,
+              what="OOM retry")
+    with open(usage_file, "w") as f:
+        f.write("0.10")
+    assert ray_tpu.get(ref, timeout=60) >= 2
+
+
+def test_oom_budget_exhausted_raises_typed_error(tmp_path):
+    usage_file = str(tmp_path / "usage")
+    with open(usage_file, "w") as f:
+        f.write("0.10")
+    ray_tpu.init(
+        num_cpus=2, object_store_memory=64 * MB,
+        _system_config={
+            "memory_monitor_test_usage_file": usage_file,
+            "memory_usage_threshold": 0.9,
+            "memory_monitor_refresh_ms": 100,
+            "memory_monitor_min_kill_interval_ms": 200,
+            "task_oom_retries": 0,       # first kill is terminal
+            "poison_task_threshold": 99,  # keep quarantine out of this
+        })
+    try:
+        started = str(tmp_path / "started")
+
+        @ray_tpu.remote(max_retries=5)
+        def parker():
+            open(started, "w").close()
+            time.sleep(120)
+            return 1
+
+        ref = parker.remote()
+        _wait_for(lambda: os.path.exists(started), what="task start")
+        with open(usage_file, "w") as f:
+            f.write("0.99")
+        with pytest.raises(OutOfMemoryError) as ei:
+            ray_tpu.get(ref, timeout=60)
+        # the receipt made it end to end: RSS + node evidence attached,
+        # and max_retries was NOT consumed by the kill (typed error, not
+        # a generic worker-death retry loop)
+        assert ei.value.rss_bytes > 0
+        assert ei.value.node_usage >= 0.9
+        assert ei.value.breakdown.get("workers")
+        with open(usage_file, "w") as f:
+            f.write("0.10")
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_quarantine_trips_fails_fast_and_clears(oom_cluster, tmp_path):
+    """poison_task_threshold=2 consecutive OOM kills of one class trip
+    the quarantine: the NEXT submission fails fast with
+    PoisonedTaskError; `rtpu quarantine clear` lifts it and the class
+    runs again."""
+    usage_file = oom_cluster
+    marker = str(tmp_path / "marker")
+
+    @ray_tpu.remote(max_retries=0)
+    def victim():
+        with open(marker, "a") as f:
+            f.write("x\n")
+        if os.path.exists(usage_file + ".park"):
+            time.sleep(120)
+        return "ok"
+
+    open(usage_file + ".park", "w").close()
+    refs = [victim.remote()]
+    _wait_for(lambda: os.path.exists(marker), what="first attempt")
+    with open(usage_file, "w") as f:
+        f.write("0.99")  # every parked attempt is OOM-killed
+    # budget 3 + the head's threshold 2: the class accumulates kills
+    # and trips; the task itself resolves with a typed error.  Under a
+    # loaded box the receipt race can occasionally lose and a kill
+    # reads as a generic worker death (max_retries=0 -> terminal, which
+    # still counts via the crash path) — the typed-error guarantees
+    # have their own dedicated tests above
+    with pytest.raises((OutOfMemoryError, PoisonedTaskError,
+                        RayWorkerError)):
+        ray_tpu.get(refs[0], timeout=90)
+
+    head = ray_tpu.api._worker().head
+
+    def tripped():
+        return any(e["quarantined"] for e in head.call(
+            "quarantine", op="list")["entries"].values())
+
+    # keep feeding parked victims (each kill reports) until the trip —
+    # robust to a lost receipt classifying some kill as a single
+    # terminal crash report
+    deadline = time.time() + 60
+    while not tripped():
+        assert time.time() < deadline, "quarantine never tripped"
+        refs.append(victim.remote())
+        time.sleep(1.0)
+    with open(usage_file, "w") as f:
+        f.write("0.10")
+    # fresh submission fails fast (no worker churn) with the history
+    with pytest.raises(PoisonedTaskError) as ei:
+        ray_tpu.get(victim.remote(), timeout=30)
+    assert ei.value.history
+    # CLI clear lifts it; with pressure gone the class runs clean once
+    # the owner's short-lived local verdict cache expires and the agents
+    # pick up the cleared gossip
+    os.unlink(usage_file + ".park")
+    from ray_tpu.scripts import main as rtpu_main
+
+    w = ray_tpu.api._worker()
+    addr = f"{w.head_addr[0]}:{w.head_addr[1]}"
+    assert rtpu_main(["quarantine", "--address", addr, "clear"]) == 0
+    assert not any(e["quarantined"] for e in head.call(
+        "quarantine", op="list")["entries"].values())
+    deadline = time.time() + 30
+    while True:
+        try:
+            assert ray_tpu.get(victim.remote(), timeout=30) == "ok"
+            break
+        except PoisonedTaskError:
+            assert time.time() < deadline, \
+                "quarantine clear never propagated"
+            time.sleep(0.5)
+
+
+def test_quarantine_protocol_ttl_expiry():
+    """Protocol-level: kill reports trip the quarantine at the
+    threshold, ok-reports reset the consecutive count, and the TTL
+    expires entries without operator action."""
+    ray_tpu.init(num_cpus=1, object_store_memory=32 * MB,
+                 _system_config={"poison_task_threshold": 3,
+                                 "poison_task_ttl_s": 1.5})
+    try:
+        head = ray_tpu.api._worker().head
+        r = head.call("task_kill_report", key="fidA", kind="oom",
+                      name="hog", node_id="n1")
+        assert not r["quarantined"]
+        # a success in between resets the consecutive count
+        head.call("task_ok_report", key="fidA")
+        head.call("task_kill_report", key="fidA", kind="oom",
+                  name="hog", node_id="n1")
+        r = head.call("task_kill_report", key="fidA", kind="crash",
+                      name="hog", node_id="n2")
+        assert not r["quarantined"], "ok-report must reset the count"
+        r = head.call("task_kill_report", key="fidA", kind="oom",
+                      name="hog", node_id="n1")
+        assert r["quarantined"] and r["history"]
+        listing = head.call("quarantine", op="list")["entries"]
+        assert listing["fidA"]["quarantined"]
+        time.sleep(1.6)  # TTL
+        listing = head.call("quarantine", op="list")["entries"]
+        assert "fidA" not in listing
+    finally:
+        ray_tpu.shutdown()
+
+
+# --------------------------------------------------- chaos worker.oom e2e
+
+
+def test_worker_oom_chaos_allocation_bomb_e2e(tmp_path):
+    """The `worker.oom` chaos site: a real allocation bomb in the
+    executing worker, caught by the watchdog under a 256MB VIRTUAL node
+    envelope (per-worker RSS sampling — the bomb worker is the
+    highest-RSS victim), typed receipt to the owner, OOM-budget retry
+    succeeds after the rules are cleared."""
+    ray_tpu.init(
+        num_cpus=2, object_store_memory=64 * MB,
+        _system_config={
+            "memory_monitor_node_total_bytes": 256 * MB,
+            "memory_usage_threshold": 0.8,
+            "memory_monitor_refresh_ms": 50,
+            "memory_monitor_min_kill_interval_ms": 100,
+            "task_oom_retries": 8,
+            "task_retry_delay_ms": 50,
+            "poison_task_threshold": 99,
+        })
+    try:
+        head = ray_tpu.api._worker().head
+        head.call("chaos", op="inject",
+                  rule={"site": "worker.oom", "action": "oom",
+                        "target": "bomb_task", "p": 1.0, "count": -1})
+        time.sleep(0.5)  # rule gossip to the agent
+
+        @ray_tpu.remote(max_retries=0, name="bomb_task")
+        def bomb_task():
+            return "survived"
+
+        ref = bomb_task.remote()
+        # first kill recorded at the head via the owner's kill report;
+        # then clear the rules so a retry attempt runs clean (the rule
+        # is per-process, so every fresh worker would re-bomb)
+        _wait_for(lambda: any(
+            e["kills"] >= 1 for e in head.call(
+                "quarantine", op="list")["entries"].values()),
+            timeout=60, what="first OOM kill report")
+        head.call("chaos", op="clear")
+        assert ray_tpu.get(ref, timeout=120) == "survived"
+    finally:
+        ray_tpu.shutdown()
+
+
+# ----------------------------------------------- pressure-aware scheduling
+
+
+def test_pick_node_demotes_pressured_nodes():
+    from ray_tpu._private.resources import NodeResources, ResourceSet
+    from ray_tpu._private.scheduler import pick_node
+
+    cluster = {"hot": NodeResources(ResourceSet({"CPU": 8})),
+               "calm": NodeResources(ResourceSet({"CPU": 8}))}
+    demand = ResourceSet({"CPU": 1})
+    pressure = {"hot": 0.97, "calm": 0.30}
+    for _ in range(10):
+        assert pick_node(cluster, demand, local_node_id="hot",
+                         pressure_by_node=pressure,
+                         pressure_threshold=0.95) == "calm"
+    # when ONLY the pressured node can fit, it still wins (a pressured
+    # node beats no node)
+    assert pick_node({"hot": cluster["hot"]}, demand, "hot",
+                     pressure_by_node=pressure,
+                     pressure_threshold=0.95) == "hot"
+    # hard affinity overrides the demotion
+    assert pick_node(cluster, demand, "calm",
+                     strategy={"type": "node_affinity", "node_id": "hot"},
+                     pressure_by_node=pressure,
+                     pressure_threshold=0.95) == "hot"
+
+
+def test_memory_resource_bin_packing():
+    """Tasks declaring memory= reserve bytes against the node's memory
+    total for real: two 160MB tasks cannot run concurrently on a 256MB
+    node."""
+    ray_tpu.init(num_cpus=4, object_store_memory=32 * MB,
+                 _system_config={
+                     "memory_monitor_node_total_bytes": 256 * MB})
+    try:
+        total = ray_tpu.cluster_resources().get("memory", 0)
+        assert total == 256 * MB
+
+        @ray_tpu.remote(memory=160 * MB, num_cpus=0)
+        def span(path, hold_s):
+            open(path, "a").close()
+            time.sleep(hold_s)
+            return time.time()
+
+        import tempfile
+
+        d = tempfile.mkdtemp()
+        t0 = time.time()
+        refs = [span.remote(os.path.join(d, f"m{i}"), 0.5)
+                for i in range(2)]
+        ends = ray_tpu.get(refs, timeout=60)
+        # serialized by the memory reservation: the second cannot start
+        # until the first's 160MB returns, so completions are >=0.4s
+        # apart (two CPUs were free the whole time)
+        assert abs(ends[0] - ends[1]) >= 0.4, ends
+        del t0
+    finally:
+        ray_tpu.shutdown()
