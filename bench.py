@@ -700,12 +700,78 @@ def bench_llm_serve(ray_tpu, pairs=2, streams=64, big_streams=256):
         if bttfts and not berrs:
             out["llm_batch_ttft_p99_ms_64"] = round(
                 p99(bttfts) * 1000.0, 1)
+
+        # ---- 80%-shared-prefix workload (ISSUE 16): copy-on-write
+        # prefix sharing A/B at `streams` concurrent SSE streams.  80%
+        # of requests carry the same 64-token system prompt + a 4-token
+        # unique tail; 20% are fully unique 68-token prompts.  Same
+        # engine shape both sides, only llm_prefix_sharing differs —
+        # the ratios isolate the sharing policy (sandbox protocol:
+        # ratios-only for timings; byte/percent counts are exact).
+        sys_prompt = [((i * 13) % 120) + 1 for i in range(64)]
+        plen = 68
+
+        def px_payload(kind):
+            def make(i):
+                if (i % 10) < 8:
+                    toks = sys_prompt + [1 + (i % 11), 2 + ((i * 3) % 13),
+                                         3 + ((i * 7) % 17), 4 + (i % 5)]
+                else:
+                    toks = [((i * 29 + j * 7) % 120) + 1
+                            for j in range(plen)]
+                return {"tokens": toks,
+                        "max_new_tokens": 16 + (i * 37) % 17,
+                        "request_id": f"{kind}{i}-{time.monotonic_ns()}"}
+            return make
+
+        for name, share in (("llm_px", True), ("llm_npx", False)):
+            serve.run(serve.llm_deployment(
+                name, max_ongoing_requests=streams + 8, max_batch=8,
+                num_pages=1 + 64 * pages_per_seq, max_queue=streams,
+                stream_flush_tokens=16, prefix_sharing=share,
+                **engine_kw))
+        _llm_stream_load(host, port, "/llm_px", 2, px_payload("w"))
+        _llm_stream_load(host, port, "/llm_npx", 2, px_payload("w"))
+        px_ttft, npx_ttft, n_req = [], [], 2  # warm streams count too
+        for _ in range(pairs):
+            toks, wall, ttfts, errs = _llm_stream_load(
+                host, port, "/llm_px", streams, px_payload("p"))
+            if errs:
+                raise RuntimeError(f"prefix-sharing run: {errs} errors")
+            px_ttft.append(p99(ttfts))
+            btoks, bwall, bttfts, berrs = _llm_stream_load(
+                host, port, "/llm_npx", streams, px_payload("n"))
+            if berrs:
+                raise RuntimeError(f"no-sharing run: {berrs} errors")
+            npx_ttft.append(p99(bttfts))
+            n_req += streams
+        px = ray_tpu.get(
+            serve.get_handle("llm_px").method("stats")(), timeout=30)
+        npx = ray_tpu.get(
+            serve.get_handle("llm_npx").method("stats")(), timeout=30)
+        # prefill tokens COMPUTED per request = prompt tokens submitted
+        # minus tokens attached from shared pages (acceptance: >= 2x
+        # drop vs the no-sharing engine at 80% shared)
+        px_prefill = (plen * n_req - px["prefix_tokens_shared"]) / n_req
+        npx_prefill = (plen * n_req - npx["prefix_tokens_shared"]) / n_req
+        out["llm_prefix_hit_pct"] = round(
+            100.0 * px["prefix_hits"] / n_req, 1)
+        out["llm_prefix_prefill_drop_x"] = round(
+            npx_prefill / px_prefill, 2)
+        out["llm_prefix_kv_bytes_per_stream"] = int(
+            px["pages_allocated_total"] * px["kv_page_bytes"] / n_req)
+        out["llm_nosharing_kv_bytes_per_stream"] = int(
+            npx["pages_allocated_total"] * npx["kv_page_bytes"] / n_req)
+        out["llm_prefix_kv_pages_drop_x"] = round(
+            npx["pages_allocated_total"] / px["pages_allocated_total"], 2)
+        out["llm_prefix_ttft_p99_vs_nosharing_x"] = round(
+            min(npx_ttft) / min(px_ttft), 2)
     finally:
         try:
             serve.shutdown_http()
         except Exception:
             pass
-        for name in ("llm_cb", "llm_sb"):
+        for name in ("llm_cb", "llm_sb", "llm_px", "llm_npx"):
             try:
                 serve.delete(name)
             except Exception:

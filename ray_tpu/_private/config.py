@@ -202,6 +202,15 @@ _def("llm_detach_grace_s", 2.0)     # KV pages survive a vanished consumer
 # this long (the re-attach window for proxy resume) before recycling
 _def("llm_done_seq_ttl_s", 30.0)    # finished sequences replayable (by
 # request_id) this long for duplicate/late retries
+_def("llm_prefix_sharing", True)    # copy-on-write prefix sharing: admit
+# sequences whose page-aligned prompt prefix matches a live sequence's
+# onto the SAME physical KV pages (refcounted; recycled at refcount 0),
+# prefilling only from the first unshared token
+_def("llm_disagg_min_prompt", 0)    # disaggregated prefill: prompts at
+# least this long route their prefill to the dedicated prefill pool
+# (when llm_deployment(prefill_replicas=N) created one); shorter
+# prompts prefill on the decode replica where queueing costs more than
+# the shipped-KV hop saves
 # --- elastic autoscaling (see autoscaler/ + head drain state machine) --------
 # sustained-demand hysteresis: backlog (demand that FITS existing nodes
 # but queues behind busy capacity) must persist for this many

@@ -751,6 +751,33 @@ def llm_metrics() -> Tuple[Counter, Gauge, Gauge, Histogram, Gauge, Gauge]:
     return _llm_metrics
 
 
+_llm_prefix_metrics: Optional[Tuple[Counter, Counter]] = None
+
+
+def llm_prefix_metrics() -> Tuple[Counter, Counter]:
+    """Process-singleton prefix-sharing / disaggregated-prefill metrics
+    (serve/llm.py):
+    ``ray_tpu_llm_prefix_hits_total`` — admissions that attached at
+    least one shared KV page from the refcounted prefix index, labeled
+    kind=page|cow (cow = a mid-page divergence that copy-on-write split
+    into a private page); ``ray_tpu_llm_kv_pages_shipped_total`` — KV
+    pages exported by prefill replicas / imported by decode replicas
+    over the bulk transfer plane, labeled direction=out|in.  The
+    shared-page population itself rides the existing
+    ``ray_tpu_llm_kv_pages`` gauge as state=shared."""
+    global _llm_prefix_metrics
+    if _llm_prefix_metrics is None:
+        _llm_prefix_metrics = (
+            Counter("ray_tpu_llm_prefix_hits_total",
+                    "LLM admissions that attached shared prefix KV pages "
+                    "(kind=page|cow)"),
+            Counter("ray_tpu_llm_kv_pages_shipped_total",
+                    "KV pages shipped between prefill and decode "
+                    "replicas (direction=out|in)"),
+        )
+    return _llm_prefix_metrics
+
+
 async def start_metrics_http_server(registry: MetricsRegistry,
                                     host: str = "127.0.0.1",
                                     port: int = 0,

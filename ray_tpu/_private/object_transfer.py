@@ -597,3 +597,55 @@ def dest_view(store, loc: dict) -> Tuple[memoryview, Optional[_MappedFile]]:
         return store.arena.view[off:off + size], None
     m = _MappedFile(loc["path"], size, writable=True)
     return m.view[:size], m
+
+
+# ------------------------------------------------- KV-page shipping format
+# Disaggregated LLM prefill (serve/llm.py) ships finished KV pages from
+# prefill replicas to decode replicas as ordinary sealed store objects —
+# the pull itself rides the bulk plane above with the seal-time CRC32 +
+# alternate-holder retry machinery.  The pack format adds its OWN crc
+# over the payload as defense in depth: a decode replica attaching pages
+# into live pools must detect corruption even when object-level
+# checksums are disabled (object_checksums=False) or the bytes came
+# from a local, never-transferred copy.
+
+_KV_MAGIC = b"RTKV"
+_KV_HDR = struct.Struct("<4sII")  # magic, crc32(payload), payload length
+
+
+def pack_kv_pages(meta: Dict, rows: Dict) -> bytes:
+    """Serialize one sequence's prefilled KV rows + metadata into a
+    self-checksummed blob.  ``meta`` is a small picklable dict (request
+    id, prompt tokens, first generated token, slot count, page size);
+    ``rows`` is {"k": [per-layer host arrays], "v": [...]} as returned
+    by models.llama.gather_kv_slots."""
+    import pickle
+    import zlib
+
+    payload = pickle.dumps({"meta": dict(meta), "rows": rows},
+                           protocol=pickle.HIGHEST_PROTOCOL)
+    return _KV_HDR.pack(_KV_MAGIC, zlib.crc32(payload),
+                        len(payload)) + payload
+
+
+def unpack_kv_pages(buf: bytes) -> Tuple[Dict, Dict]:
+    """Parse and byte-verify a pack_kv_pages blob -> (meta, rows).
+    Raises TransferError on a bad magic, length, or crc — the caller
+    (decode-replica attach) falls back to a local re-prefill rather
+    than scattering corrupt rows into live KV pools."""
+    import pickle
+    import zlib
+
+    if len(buf) < _KV_HDR.size:
+        raise TransferError(f"kv pack too short ({len(buf)} bytes)")
+    magic, crc, length = _KV_HDR.unpack_from(buf)
+    payload = bytes(buf[_KV_HDR.size:])
+    if magic != _KV_MAGIC or len(payload) != length:
+        raise TransferError("kv pack header mismatch")
+    actual = zlib.crc32(payload)
+    if actual != crc:
+        raise TransferError(
+            f"kv pack checksum mismatch: payload crc {actual:#010x} "
+            f"!= packed crc {crc:#010x}")
+    d = pickle.loads(payload)
+    return d["meta"], d["rows"]

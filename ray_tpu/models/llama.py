@@ -335,6 +335,42 @@ def make_kv_pools(cfg: LlamaConfig, num_slots: int,
             "v": [jnp.zeros(shape, dtype) for _ in range(cfg.n_layers)]}
 
 
+def gather_kv_slots(pools: Dict[str, Any], slots: Any) -> Dict[str, Any]:
+    """Read the KV rows at ``slots`` out of every layer's pool as host
+    numpy arrays — the export half of KV-page shipping (serve/llm.py
+    disaggregated prefill).  Paging-agnostic: ``slots`` is whatever flat
+    slot indices the caller's block tables resolve to."""
+    import numpy as np
+
+    idx = np.asarray(slots, np.int32)
+    return {"k": [np.asarray(p[idx]) for p in pools["k"]],
+            "v": [np.asarray(p[idx]) for p in pools["v"]]}
+
+
+def scatter_kv_slots(pools: Dict[str, Any], slots: Any,
+                     rows: Dict[str, Any]) -> Dict[str, Any]:
+    """Write previously-gathered KV rows into ``slots`` of every
+    layer's pool (the import half of KV-page shipping).  Returns the
+    updated pools — jax arrays are immutable, so callers must adopt the
+    result."""
+    idx = jnp.asarray(slots, jnp.int32)
+    return {"k": [p.at[idx].set(jnp.asarray(r, p.dtype))
+                  for p, r in zip(pools["k"], rows["k"])],
+            "v": [p.at[idx].set(jnp.asarray(r, p.dtype))
+                  for p, r in zip(pools["v"], rows["v"])]}
+
+
+def copy_kv_slots(pools: Dict[str, Any], src_slots: Any,
+                  dst_slots: Any) -> Dict[str, Any]:
+    """Copy KV rows ``src_slots`` -> ``dst_slots`` within every layer's
+    pool — the copy-on-write split when a sequence diverges mid-page
+    from a shared prefix page.  Returns the updated pools."""
+    src = jnp.asarray(src_slots, jnp.int32)
+    dst = jnp.asarray(dst_slots, jnp.int32)
+    return {"k": [p.at[dst].set(p[src]) for p in pools["k"]],
+            "v": [p.at[dst].set(p[src]) for p in pools["v"]]}
+
+
 def kv_pool_bytes(cfg: LlamaConfig, num_slots: int) -> int:
     """Resident bytes of one replica's KV pools (both k and v)."""
     itemsize = jnp.dtype(cfg.dtype).itemsize

@@ -199,6 +199,11 @@ class Deployment:
     # refused without it (a duplicate non-idempotent request could
     # double-apply side effects)
     idempotent: bool = False
+    # LLM deployments only: run chunked prefill on this many dedicated
+    # replicas (a sibling "<name>-prefill" pool); decode replicas attach
+    # the shipped KV pages by request_id (serve/llm.py).  0 = colocated
+    # prefill (the PR-11 behaviour).
+    prefill_replicas: int = 0
 
     def options(self, **opts) -> "Deployment":
         d = Deployment(self.func_or_class, self.name, self.num_replicas,
@@ -208,7 +213,8 @@ class Deployment:
                        dict(self.autoscaling_config)
                        if self.autoscaling_config else None,
                        self.llm, self.request_timeout_s,
-                       self.hedge_after_s, self.idempotent)
+                       self.hedge_after_s, self.idempotent,
+                       self.prefill_replicas)
         for k, v in opts.items():
             setattr(d, k, v)
         return d
@@ -216,9 +222,12 @@ class Deployment:
     def policy(self) -> Dict[str, Any]:
         """The wire form of the tail-tolerance policy (stored by the
         controller, learned by every handle via get_replicas)."""
-        return {"request_timeout_s": self.request_timeout_s,
-                "hedge_after_s": self.hedge_after_s,
-                "idempotent": bool(self.idempotent)}
+        pol = {"request_timeout_s": self.request_timeout_s,
+               "hedge_after_s": self.hedge_after_s,
+               "idempotent": bool(self.idempotent)}
+        if self.llm and self.prefill_replicas:
+            pol["prefill_pool"] = f"{self.name}-prefill"
+        return pol
 
     def bind(self, *args, **kwargs) -> "Application":
         d = self.options()
@@ -1265,6 +1274,9 @@ class DeploymentHandle:
         # admission), drained to the controller with each metrics push —
         # the replica autoscaler's immediate scale-up trigger
         self._sheds_pending = 0
+        # lazily-built handle to the sibling "<name>-prefill" pool
+        # (disaggregated prefill; see _maybe_prefill)
+        self._prefill_handle: Optional["DeploymentHandle"] = None
         _metrics_pusher.register(self)
 
     def note_shed(self) -> None:
@@ -1777,6 +1789,56 @@ class DeploymentHandle:
 
         return _wrapped()
 
+    async def _maybe_prefill(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """Disaggregated-prefill hop: when the deployment's policy names
+        a prefill pool, route the request's prefill phase to a dedicated
+        replica there first.  The pool replica runs chunked prefill,
+        exports the finished KV pages into the object store (the
+        cross-node pull rides the bulk transfer plane, checksummed with
+        alternate-holder retry), and returns a ``kv_ref``; the decode
+        replica attaches the shipped pages by request_id and starts at
+        the first generated token.  Any prefill-pool failure other than
+        a deadline falls back to colocated prefill on the decode replica
+        — disaggregation is an optimisation, never a new failure mode.
+        Deadline errors propagate: the budget is gone either way."""
+        pool = self._policy.get("prefill_pool")
+        if (not pool or not isinstance(request, dict)
+                or request.get("kv_ref") is not None
+                or not request.get("tokens")):
+            return request
+        from ray_tpu._private.config import config
+        from ray_tpu._private.errors import DeadlineExceededError
+        try:
+            if len(request["tokens"]) < int(config.llm_disagg_min_prompt):
+                return request
+        except TypeError:
+            return request
+        request = dict(request)
+        if not request.get("request_id"):
+            import uuid
+
+            request["request_id"] = uuid.uuid4().hex
+        handle = self._prefill_handle
+        if handle is None or handle._name != pool:
+            import asyncio
+
+            loop = asyncio.get_running_loop()
+            try:
+                handle = await loop.run_in_executor(
+                    None, get_handle, pool)
+            except Exception:
+                return request  # pool missing/unhealthy: prefill locally
+            self._prefill_handle = handle
+        try:
+            meta = await handle.call_async(request, _method="prefill")
+        except DeadlineExceededError:
+            raise
+        except Exception:
+            return request  # fall back to colocated prefill
+        if isinstance(meta, dict) and meta.get("kv_ref") is not None:
+            request["kv_ref"] = meta["kv_ref"]
+        return request
+
     async def stream_async(self, *args, _method: str = "__call__",
                            _exclude=None, _info=None, **kwargs):
         """Async stream(): returns an async iterator of per-item
@@ -1795,6 +1857,9 @@ class DeploymentHandle:
         await self._refresh_async()
         if not self._replicas:
             await self._refresh_async(force=True)
+        if (_method == "__call__" and not _exclude and len(args) == 1
+                and isinstance(args[0], dict)):
+            args = (await self._maybe_prefill(args[0]),)
         replica, rid = self._pick_replica(local_pref=False,
                                           exclude=_exclude)
         if _info is not None:
@@ -1874,14 +1939,31 @@ def run(app: Application, name: Optional[str] = None) -> DeploymentHandle:
             int(config.serve_autoscale_target_ongoing))
         num_replicas = int(autoscaling["min_replicas"])
     ctrl = _controller()
+    pol = d.policy()
+    blob = cloudpickle.dumps(d.func_or_class)
+    health_timeout = float(config.serve_replica_health_timeout_s)
     try:
+        if d.llm and int(d.prefill_replicas or 0) > 0:
+            # disaggregated prefill: a sibling pool of identical llm
+            # replicas handles the prefill phase only; handles learn the
+            # pool name via the decode deployment's policy and ship the
+            # finished KV pages over the bulk plane
+            pool_name = f"{dep_name}-prefill"
+            pol["prefill_pool"] = pool_name
+            pool_pol = {k: v for k, v in pol.items()
+                        if k != "prefill_pool"}
+            ray_tpu.get(ctrl.deploy.remote(
+                pool_name, blob, int(d.prefill_replicas),
+                d.max_ongoing_requests, d.init_args, d.init_kwargs,
+                d.ray_actor_options, None, health_timeout, d.llm,
+                pool_pol), timeout=health_timeout + 120.0)
         ray_tpu.get(ctrl.deploy.remote(
-            dep_name, cloudpickle.dumps(d.func_or_class), num_replicas,
+            dep_name, blob, num_replicas,
             d.max_ongoing_requests, d.init_args, d.init_kwargs,
             d.ray_actor_options, autoscaling,
-            float(config.serve_replica_health_timeout_s), d.llm,
-            d.policy()),
-            timeout=float(config.serve_replica_health_timeout_s) + 120.0)
+            health_timeout, d.llm,
+            pol),
+            timeout=health_timeout + 120.0)
     except ray_tpu.RayTaskError as e:
         if isinstance(e.cause, DeploymentFailedError):
             raise e.cause from None  # typed: callers can catch it

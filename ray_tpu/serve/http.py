@@ -652,6 +652,14 @@ class _HttpProxy:
         import ray_tpu
 
         info: Dict[str, Any] = {}
+        if isinstance(arg, dict) and not arg.get("request_id"):
+            # stamp the rid HERE, before the first submit: the
+            # disaggregated-prefill hop (handle._maybe_prefill) and any
+            # mid-stream resume then address the same engine sequence —
+            # shipped KV pages and re-attach both key on request_id
+            import uuid
+
+            arg["request_id"] = uuid.uuid4().hex
         handle = await self._resolve_handle_async(name)
         agen = await handle.stream_async(arg, _info=info)
 

@@ -39,6 +39,28 @@ which the proxy maps to the PR-3 503 shed gate.  Sequences whose
 consumer vanished (SSE disconnect -> generator cancel) keep their pages
 only for ``llm_detach_grace_s`` — the re-attach window for transparent
 resume — then are cancelled and recycled.
+
+Copy-on-write prefix sharing (``llm_prefix_sharing``): page-aligned
+token-prefix blocks are hashed into a per-engine refcounted prefix
+index as prefill completes them; a new sequence whose prompt prefix
+matches attaches to the SAME physical pages (refcount + 1, recycled
+only at refcount 0) and prefills from the first unshared token.  A
+divergence MID-page copies the shared head of that page into a private
+page (copy-on-write) before the diverging tokens are written.  Shared
+pages are immutable by construction — a sequence only ever writes at
+positions >= its own ``pos``, and a page enters the index only once
+every sequence write past it has happened.
+
+Disaggregated prefill (``llm_deployment(prefill_replicas=N)``): a
+sibling replica pool runs ONLY chunked prefill (``prefill_request``),
+exports the finished KV pages via models.llama.gather_kv_slots +
+object_transfer.pack_kv_pages, and ships them to decode replicas as a
+sealed store object over the PR-4 bulk transfer plane (seal-time CRC32,
+alternate-holder retry on a corrupt pull).  The decode replica attaches
+the pages by request_id (``submit(kv_pack=...)``) and starts at its
+first decode step — long prompts never occupy decode-lane steps, and
+the deadline admission gate prices the two phases separately
+(prefill-only: chunk cost; attach: one decode step).
 """
 
 from __future__ import annotations
@@ -64,8 +86,22 @@ class LLMOverloadedError(RuntimeError):
 _QUEUED = "queued"
 _PREFILL = "prefill"
 _DECODE = "decode"
+_SHIP = "ship"  # prefill-only sequence whose pages were just exported
 
 _forward_cache: Dict[int, Any] = {}
+
+# prefix-index chain seed: block k's key hashes (parent key || block
+# tokens), so one digest equality implies the WHOLE prefix matches
+_PREFIX_SEED = b"rtpu-prefix-v1"
+
+
+def _chain_hash(parent: bytes, block) -> bytes:
+    import hashlib
+
+    h = hashlib.blake2b(parent, digest_size=16)
+    for t in block:
+        h.update(int(t).to_bytes(4, "little", signed=True))
+    return h.digest()
 
 
 def _jit_forward(model, params, k, v, tokens, slots, ctx, ctx_pos,
@@ -125,7 +161,8 @@ class _Seq:
                  "max_new", "eos", "block_table", "pos", "state", "done",
                  "error", "attach_count", "detached_at", "done_at",
                  "submitted_at", "first_token_at", "cancelled",
-                 "slot_cache", "cond", "deadline")
+                 "slot_cache", "cond", "deadline", "kv_import",
+                 "prefill_export", "export_payload")
 
     def __init__(self, request_id: str, prompt: List[int], max_new: int,
                  eos: Optional[int], preknown: Optional[List[int]] = None):
@@ -158,6 +195,13 @@ class _Seq:
         # the sweep cancels expired in-flight sequences and recycles
         # their pages instead of decoding for a caller that moved on
         self.deadline = 0.0
+        # disaggregated prefill: shipped KV rows waiting to be scattered
+        # into this engine's pools (decode side), or the flag/result of
+        # a prefill-only pass whose pages are exported instead of
+        # decoded (prefill side)
+        self.kv_import: Optional[Dict[str, Any]] = None
+        self.prefill_export = False
+        self.export_payload: Optional[Dict[str, Any]] = None
 
     @property
     def total_len(self) -> int:
@@ -194,7 +238,8 @@ class LLMEngine:
                  stream_flush_tokens: Optional[int] = None,
                  dtype: Any = None,
                  temperature: Optional[float] = None,
-                 top_k: Optional[int] = None):
+                 top_k: Optional[int] = None,
+                 prefix_sharing: Optional[bool] = None):
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -264,6 +309,27 @@ class LLMEngine:
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self._free_pages: List[int] = list(range(1, self.num_pages))
+        # ---- copy-on-write prefix sharing ----
+        # page_refs[p]: sequences whose block table includes page p —
+        # pages recycle to _free_pages only at refcount 0.  The prefix
+        # index maps a chain hash over page-aligned token blocks to ONE
+        # immutable page holding that block's KV; _children groups
+        # registered pages under their parent-chain hash so a mid-page
+        # divergence can find its copy-on-write source.
+        self.prefix_sharing = bool(
+            prefix_sharing if prefix_sharing is not None
+            else config.llm_prefix_sharing)
+        self._page_refs = [0] * self.num_pages
+        self._prefix_index: Dict[bytes, int] = {}
+        self._children: Dict[bytes, set] = {}
+        self._page_tokens: Dict[int, tuple] = {}
+        self._page_keys: Dict[int, tuple] = {}
+        self._prefix_hits = 0
+        self._prefix_tokens_shared = 0
+        self._cow_splits = 0
+        self._pages_alloc_total = 0
+        self._kv_pages_shipped_out = 0
+        self._kv_pages_shipped_in = 0
         self._queued: deque = deque()
         self._active: List[_Seq] = []
         self._by_rid: Dict[str, _Seq] = {}
@@ -285,10 +351,21 @@ class LLMEngine:
 
     # ------------------------------------------------------------ admission
 
-    def submit(self, request: Dict[str, Any]) -> _Seq:
+    def submit(self, request: Dict[str, Any],
+               kv_pack: Optional[tuple] = None) -> _Seq:
         """Admit (or re-attach to) one sequence.  Raises
         LLMOverloadedError when the admission queue is full, ValueError
-        on requests that can never fit."""
+        on requests that can never fit.
+
+        ``kv_pack`` is an unpacked (meta, rows) KV shipment from a
+        prefill replica (object_transfer.unpack_kv_pages): the sequence
+        skips prefill entirely — the step loop scatters the rows into
+        this engine's pools and the sequence enters decode at the
+        shipped position.  A pack that does not match the request's
+        prompt is discarded (local prefill is always correct, just
+        slower).  A request carrying ``_phase == "prefill"`` is
+        prefill-ONLY: its pages are exported and recycled at the end of
+        prefill instead of decoding (see prefill_request)."""
         import uuid
 
         if not isinstance(request, dict) or not request.get("tokens"):
@@ -300,6 +377,15 @@ class LLMEngine:
         eos = request.get("eos")
         eos = int(eos) if eos is not None else None
         rid = str(request.get("request_id") or uuid.uuid4().hex[:16])
+        prefill_only = request.get("_phase") == "prefill"
+        if kv_pack is not None:
+            meta = kv_pack[0]
+            # the shipment must describe exactly this prompt: the rows
+            # are attached positionally, so any mismatch would decode
+            # against another request's KV
+            if (list(meta.get("tokens") or []) != prompt
+                    or int(meta.get("n", -1)) != len(prompt)):
+                kv_pack = None
         # end-to-end deadline: the ambient context (stamped into the
         # replica task by the handle / the X-Request-Deadline-Ms
         # ingress header) combined with an explicit request-dict
@@ -315,11 +401,20 @@ class LLMEngine:
             # burn pages and batch lanes producing tokens its caller
             # will never read.  Cost model: measured step EWMA x
             # (prefill chunks + 1); a cold engine (no measured step
-            # yet) only refuses already-expired budgets.
+            # yet) only refuses already-expired budgets.  The two
+            # disaggregated phases price separately: a prefill-only
+            # pass needs its chunks but no decode step, and a sequence
+            # arriving WITH shipped KV needs one decode step but no
+            # prefill chunks.
             need = 0.0
             if self._step_ewma > 0.0:
                 chunks = -(-len(prompt) // self.prefill_chunk)
-                need = self._step_ewma * (chunks + 1)
+                if kv_pack is not None:
+                    need = self._step_ewma
+                elif prefill_only:
+                    need = self._step_ewma * chunks
+                else:
+                    need = self._step_ewma * (chunks + 1)
             if rem <= need:
                 self._deadline_expired_total += 1
                 deadlines.count_exceeded("admission")
@@ -359,6 +454,9 @@ class LLMEngine:
             seq.deadline = dl
             seq.cond = threading.Condition(self._lock)
             seq.attach_count = 1
+            seq.prefill_export = prefill_only
+            if kv_pack is not None:
+                seq.kv_import = {"meta": kv_pack[0], "rows": kv_pack[1]}
             self._by_rid[rid] = seq
             self._queued.append(seq)
             self._cond.notify_all()  # wake the parked decode loop
@@ -450,10 +548,38 @@ class LLMEngine:
     def _alloc_pages(self, n: int) -> List[int]:
         pages = self._free_pages[:n]
         del self._free_pages[:n]
+        for p in pages:
+            self._page_refs[p] = 1
+        self._pages_alloc_total += len(pages)
         return pages
 
+    def _release_pages(self, pages: List[int]) -> None:
+        """Lock held.  Drop one reference per page; pages reaching
+        refcount 0 return to the free list and leave the prefix index
+        (a later lookup must never attach to a recycled page)."""
+        freed = []
+        for p in pages:
+            self._page_refs[p] -= 1
+            if self._page_refs[p] <= 0:
+                self._page_refs[p] = 0
+                freed.append(p)
+                keys = self._page_keys.pop(p, None)
+                if keys is not None:
+                    parent, own = keys
+                    if self._prefix_index.get(own) == p:
+                        del self._prefix_index[own]
+                    kids = self._children.get(parent)
+                    if kids is not None:
+                        kids.discard(p)
+                        if not kids:
+                            del self._children[parent]
+                self._page_tokens.pop(p, None)
+        self._free_pages.extend(freed)
+
     def _finish_seq(self, seq: _Seq, cancelled: bool = False) -> None:
-        """Lock held.  Mark done and recycle pages immediately."""
+        """Lock held.  Mark done and release page references
+        immediately — physical pages recycle only at refcount 0 (other
+        sequences may still be decoding against a shared prefix)."""
         seq.done = True
         seq.cancelled = cancelled
         if cancelled:
@@ -461,8 +587,9 @@ class LLMEngine:
         seq.done_at = time.monotonic()
         if seq.cond is not None:
             seq.cond.notify_all()
-        self._free_pages.extend(seq.block_table)
+        self._release_pages(seq.block_table)
         seq.block_table = []
+        seq.kv_import = None
         if seq in self._active:
             self._active.remove(seq)
         try:
@@ -505,20 +632,139 @@ class LLMEngine:
                     and now - seq.done_at > ttl:
                 del self._by_rid[rid]
 
+    def _match_prefix(self, seq: _Seq):
+        """Lock held.  Longest shared-prefix match for ``seq`` against
+        the refcounted index: returns (shared_pages, cow) where
+        ``shared_pages`` are live physical pages whose KV covers the
+        first ``len(shared_pages) * page_size`` prefill tokens
+        verbatim, and ``cow`` is an optional (source_page, n_tokens)
+        mid-page extension to copy-on-write into a private page.  At
+        least ONE token is always left for prefill — the final prompt
+        position's logits are what produce the first generated token."""
+        toks = seq.prefill_tokens
+        ps = self.page_size
+        limit = len(toks) - 1
+        shared: List[int] = []
+        if limit < 1 or not self._children:
+            return shared, None
+        h = _PREFIX_SEED
+        p = 0
+        while (p + 1) * ps <= limit:
+            block = tuple(toks[p * ps:(p + 1) * ps])
+            child = _chain_hash(h, block)
+            page = self._prefix_index.get(child)
+            # digest equality implies the whole prefix matches; the
+            # token compare turns a (cosmically unlikely) hash
+            # collision into a miss instead of a wrong-KV decode
+            if page is None or self._page_refs[page] <= 0 \
+                    or self._page_tokens.get(page) != block:
+                break
+            shared.append(page)
+            h = child
+            p += 1
+        # mid-page extension: a registered page under the same parent
+        # chain whose leading tokens match is a copy-on-write source —
+        # its shared head is copied into the diverging sequence's
+        # private page so prefill starts at the first unshared token
+        cow = None
+        rem = min(limit - p * ps, ps)
+        if rem > 0:
+            best, best_page = 0, None
+            want = toks[p * ps:p * ps + rem]
+            for cand in self._children.get(h, ()):
+                ct = self._page_tokens.get(cand)
+                if not ct or self._page_refs[cand] <= 0:
+                    continue
+                m = 0
+                for a, b in zip(ct, want):
+                    if a != b:
+                        break
+                    m += 1
+                if m > best:
+                    best, best_page = m, cand
+            if best > 0:
+                cow = (best_page, best)
+        return shared, cow
+
+    def _register_prefix_pages(self, seq: _Seq) -> None:
+        """Lock held.  Enter ``seq``'s fully-written prefill pages into
+        the prefix index.  A page is registered only once the sequence's
+        ``pos`` passed its end (all slots written, and no future write
+        can touch it — writes only happen at >= pos) and only within
+        the prefill region (decode-extended pages are private).
+        Idempotent: already-registered pages (including ones attached
+        FROM the index) are skipped."""
+        if not self.prefix_sharing:
+            return
+        ps = self.page_size
+        toks = seq.prefill_tokens
+        max_page = min(seq.pos, len(toks)) // ps
+        h = _PREFIX_SEED
+        for p in range(max_page):
+            block = tuple(toks[p * ps:(p + 1) * ps])
+            child = _chain_hash(h, block)
+            page = seq.block_table[p]
+            if page not in self._page_keys and self._page_refs[page] > 0:
+                # first registration wins; an identical-content page
+                # from another sequence stays unregistered (it will be
+                # recycled at its own refcount 0)
+                self._prefix_index.setdefault(child, page)
+                self._children.setdefault(h, set()).add(page)
+                self._page_tokens[page] = block
+                self._page_keys[page] = (h, child)
+            h = child
+
+    def _cow_copy(self, src_page: int, dst_page: int, n_tok: int) -> None:
+        """Lock held, loop-synchronized (only ever called from within a
+        step, never concurrent with a forward): copy the first
+        ``n_tok`` KV rows of ``src_page`` into ``dst_page``."""
+        from ray_tpu.models.llama import copy_kv_slots
+
+        np = self._np
+        ps = self.page_size
+        src = np.arange(n_tok, dtype=np.int32) + src_page * ps
+        dst = np.arange(n_tok, dtype=np.int32) + dst_page * ps
+        self._pools = copy_kv_slots(self._pools, src, dst)
+
     def _admit_locked(self) -> None:
         while self._queued and len(self._active) < self.max_batch:
             seq = self._queued[0]
             pages = -(-seq.total_len // self.page_size)
-            if pages > len(self._free_pages):
+            shared: List[int] = []
+            cow = None
+            if self.prefix_sharing and seq.kv_import is None \
+                    and not seq.block_table:
+                shared, cow = self._match_prefix(seq)
+            if pages - len(shared) > len(self._free_pages):
                 break  # head-of-line waits for pages to recycle
             self._queued.popleft()
-            seq.block_table = self._alloc_pages(pages)
+            for p in shared:
+                self._page_refs[p] += 1
+            seq.block_table = shared + self._alloc_pages(
+                pages - len(shared))
             np = self._np
             bt = np.asarray(seq.block_table, np.int64)
             seq.slot_cache = (np.repeat(bt * self.page_size,
                                         self.page_size)
                               + np.tile(np.arange(self.page_size),
                                         len(bt))).astype(np.int32)
+            shared_tok = len(shared) * self.page_size
+            if cow is not None:
+                src_page, n_tok = cow
+                self._cow_copy(src_page, seq.block_table[len(shared)],
+                               n_tok)
+                self._cow_splits += 1
+                shared_tok += n_tok
+            if shared_tok:
+                # prefill starts at the first unshared token: the
+                # attached pages already hold this prefix's KV
+                seq.pos = shared_tok
+                self._prefix_hits += 1
+                self._prefix_tokens_shared += shared_tok
+                m = self.metrics()
+                if m is not None:
+                    m["prefix_hits"].inc(
+                        tags={"kind": "cow" if cow else "page"})
             seq.state = _PREFILL
             self._active.append(seq)
 
@@ -544,6 +790,104 @@ class LLMEngine:
             # past it (n = 1, F+1, 2F+1, ...), not one window late
             seq.cond.notify_all()
 
+    # ------------------------------------------- disaggregated prefill
+    # Export and import both touch the KV pools, so they only ever run
+    # INSIDE a step, under the engine lock, never concurrent with a
+    # forward (whose donated pool buffers would be invalidated under a
+    # concurrent reader/writer).
+
+    def _attach_imports_locked(self) -> bool:
+        """Scatter shipped KV rows for freshly-admitted sequences into
+        this engine's pools; the sequence enters decode at the shipped
+        position with the prefill replica's first generated token
+        already emitted.  Returns True when any import happened."""
+        imports = [s for s in self._active
+                   if s.kv_import is not None and s.state == _PREFILL]
+        for seq in imports:
+            pack, seq.kv_import = seq.kv_import, None
+            n = int(pack["meta"]["n"])
+            first_tok = int(pack["meta"]["first_token"])
+            from ray_tpu.models.llama import scatter_kv_slots
+
+            self._pools = scatter_kv_slots(self._pools,
+                                           seq.slot_cache[:n],
+                                           pack["rows"])
+            seq.pos = n
+            n_pages = -(-n // self.page_size)
+            self._kv_pages_shipped_in += n_pages
+            m = self.metrics()
+            if m is not None:
+                m["shipped"].inc(n_pages, tags={"direction": "in"})
+            # imported pages carry a complete prompt prefix: register
+            # them so later same-prefix admissions share instead of
+            # re-importing or re-prefilling
+            self._register_prefix_pages(seq)
+            seq.state = _DECODE
+            self._emit_token(seq, first_tok)
+        return bool(imports)
+
+    def _export_seq_locked(self, seq: _Seq, first_token: int) -> None:
+        """Prefill-only sequence finished its last chunk: gather its KV
+        rows to host memory, stash them as the export payload, and
+        finish the sequence (pages recycle NOW — the payload is a host
+        copy).  ``prefill_request`` wakes on the finish notify."""
+        from ray_tpu.models.llama import gather_kv_slots
+
+        if seq.first_token_at is None:
+            seq.first_token_at = time.monotonic()
+            m = self.metrics()
+            if m is not None:
+                m["ttft"].observe(seq.first_token_at - seq.submitted_at)
+        seq.generated.append(int(first_token))
+        n = seq.pos
+        n_pages = -(-n // self.page_size)
+        seq.export_payload = {
+            "meta": {"request_id": seq.request_id,
+                     "tokens": list(seq.prompt),
+                     "first_token": int(first_token),
+                     "n": n, "pages": n_pages,
+                     "page_size": self.page_size},
+            "rows": gather_kv_slots(self._pools, seq.slot_cache[:n]),
+        }
+        self._kv_pages_shipped_out += n_pages
+        m = self.metrics()
+        if m is not None:
+            m["shipped"].inc(n_pages, tags={"direction": "out"})
+        seq.state = _SHIP
+        self._finish_seq(seq)
+
+    def prefill_request(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """Run ONLY the prefill phase for ``request`` and return the
+        export payload ({"meta", "rows"}) for shipping to a decode
+        replica.  Drives the engine inline when no pinned loop is
+        running (bench/test harnesses); under a loop it parks on the
+        sequence condition like any consumer.  Idempotent by
+        request_id within the done-seq TTL: a retried prefill replays
+        the stashed payload instead of recomputing."""
+        req = dict(request)
+        req["_phase"] = "prefill"
+        seq = self.submit(req)
+        try:
+            while True:
+                with self._lock:
+                    if seq.export_payload is not None:
+                        return seq.export_payload
+                    if seq.error is not None:
+                        raise seq.error
+                    if seq.done:
+                        # swept (deadline/grace) before export finished
+                        raise LLMOverloadedError(
+                            f"prefill for {seq.request_id} was cancelled "
+                            f"before its pages could be exported")
+                    inline = not self._loop_running
+                    if not inline:
+                        (seq.cond or self._cond).wait(0.1)
+                if inline:
+                    if not self.step():
+                        time.sleep(0.001)
+        finally:
+            self.release(seq)
+
     def step(self) -> bool:
         """One engine iteration: admit, one prefill chunk, one decode
         pass over every decoding sequence.  Returns False when there was
@@ -554,6 +898,7 @@ class LLMEngine:
         with self._lock:
             self._sweep(now)
             self._admit_locked()
+            imported = self._attach_imports_locked()
             prefills = [s for s in self._active
                         if s.state == _PREFILL][:self.prefill_lanes]
             decode = [s for s in self._active if s.state == _DECODE]
@@ -562,7 +907,8 @@ class LLMEngine:
                 self._last_step_tokens = 0
                 self._set_gauges()  # idle must publish zeros, not
                 # freeze the last busy step's values into the ring
-                return False
+                return imported  # an import that finished immediately
+                # (max_new=1 / eos) still counts as work done
             prefill_args = []
             for seq in prefills:
                 lo = seq.pos
@@ -612,9 +958,17 @@ class LLMEngine:
                     if seq.done:
                         continue  # cancelled mid-chunk: pages already back
                     seq.pos = hi
+                    # pages this chunk completed are immutable now —
+                    # enter them into the prefix index so later
+                    # admissions with the same prompt prefix share them
+                    self._register_prefix_pages(seq)
                     if hi == len(seq.prefill_tokens):
-                        seq.state = _DECODE
-                        self._emit_token(seq, int(next_tok[lane]))
+                        if seq.prefill_export:
+                            self._export_seq_locked(
+                                seq, int(next_tok[lane]))
+                        else:
+                            seq.state = _DECODE
+                            self._emit_token(seq, int(next_tok[lane]))
             m = self.metrics()
             if m is not None:
                 m["tokens"].inc(chunk_tokens, tags={"phase": "prefill"})
@@ -734,15 +1088,23 @@ class LLMEngine:
     def metrics(self):
         if self._metrics is None:
             try:
-                from ray_tpu._private.metrics import llm_metrics
+                from ray_tpu._private.metrics import (llm_metrics,
+                                                      llm_prefix_metrics)
 
                 tokens, pages, batch, ttft, queue, tps = llm_metrics()
+                prefix_hits, shipped = llm_prefix_metrics()
                 self._metrics = {"tokens": tokens, "pages": pages,
                                  "batch": batch, "ttft": ttft,
-                                 "queue": queue, "tps": tps}
+                                 "queue": queue, "tps": tps,
+                                 "prefix_hits": prefix_hits,
+                                 "shipped": shipped}
             except Exception:
                 return None
         return self._metrics
+
+    def _shared_page_count(self) -> int:
+        """Lock held: pages referenced by more than one sequence."""
+        return sum(1 for r in self._page_refs if r > 1)
 
     def _set_gauges(self) -> None:
         m = self.metrics()
@@ -751,6 +1113,7 @@ class LLMEngine:
         m["pages"].set(self.num_pages - 1 - len(self._free_pages),
                        tags={"state": "used"})
         m["pages"].set(len(self._free_pages), tags={"state": "free"})
+        m["pages"].set(self._shared_page_count(), tags={"state": "shared"})
         m["batch"].set(self._last_batch)
         m["queue"].set(len(self._queued))
         m["tps"].set(self._last_step_tokens)
@@ -765,6 +1128,17 @@ class LLMEngine:
                     "live_seqs": len(self._by_rid),
                     "free_pages": len(self._free_pages),
                     "used_pages": self.num_pages - 1 - len(self._free_pages),
+                    "shared_pages": self._shared_page_count(),
+                    "prefix_hits": self._prefix_hits,
+                    "prefix_tokens_shared": self._prefix_tokens_shared,
+                    "cow_splits": self._cow_splits,
+                    "pages_allocated_total": self._pages_alloc_total,
+                    "kv_page_bytes": (
+                        sum(int(p.nbytes) for p in self._pools["k"])
+                        + sum(int(p.nbytes) for p in self._pools["v"]))
+                        // self.num_pages,
+                    "kv_pages_shipped_out": self._kv_pages_shipped_out,
+                    "kv_pages_shipped_in": self._kv_pages_shipped_in,
                     "loop_running": self._loop_running,
                     "last_batch": self._last_batch}
 
@@ -834,13 +1208,48 @@ class _LLMCallable:
 
     def __call__(self, request):
         emit_from = 0
+        kv_pack = None
         if isinstance(request, dict):
             emit_from = int(request.get("emit_from") or 0)
-        seq = self._engine.submit(request)
+            if request.get("kv_ref") is not None:
+                # disaggregated prefill: resolve the shipped KV pages
+                # (the get pulls over the checksummed bulk plane when
+                # the prefill replica lives on another node).  ANY
+                # failure — pull error, pack corruption — falls back to
+                # a local prefill: always correct, just slower.
+                request = dict(request)
+                ref = request.pop("kv_ref")
+                try:
+                    import ray_tpu
+                    from ray_tpu._private.object_transfer import \
+                        unpack_kv_pages
+
+                    kv_pack = unpack_kv_pages(
+                        ray_tpu.get(ref, timeout=30.0))
+                except Exception:
+                    kv_pack = None
+        seq = self._engine.submit(request, kv_pack=kv_pack)
         try:
             yield from self._engine.iter_tokens(seq, emit_from)
         finally:
             self._engine.release(seq)
+
+    def prefill(self, request):
+        """Prefill-pool endpoint: run the prefill phase only, put the
+        packed KV pages into the object store, and return the shipping
+        metadata the handle forwards to a decode replica.  The decode
+        replica's pull of the ref rides the bulk transfer plane."""
+        import ray_tpu
+        from ray_tpu._private.object_transfer import pack_kv_pages
+
+        payload = self._engine.prefill_request(request)
+        buf = pack_kv_pages(payload["meta"], payload["rows"])
+        meta = payload["meta"]
+        return {"request_id": meta["request_id"],
+                "kv_ref": ray_tpu.put(buf),
+                "first_token": meta["first_token"],
+                "n": meta["n"], "pages": meta["pages"],
+                "nbytes": len(buf)}
 
     def generate(self, request):
         """Non-streaming convenience: the full generation as one list
@@ -915,13 +1324,20 @@ def llm_deployment(name: str = "llm", *, num_replicas: Any = 1,
                    autoscaling_config: Optional[Dict[str, Any]] = None,
                    request_timeout_s: Optional[float] = None,
                    hedge_after_s: Any = None, idempotent: bool = False,
+                   prefill_replicas: int = 0,
                    **engine_kwargs):
     """Build an LLM serving Application: replicas host an
     :class:`LLMEngine` and the controller installs the pinned decode
     loop on each one.  ``engine_kwargs`` go to :class:`LLMEngine`
     (model=, page_size=, num_pages=, max_batch=, prefill_chunk=,
-    max_queue=, seed=, detach_grace_s=); unset knobs fall back to the
-    ``llm_*`` config defaults.
+    max_queue=, seed=, detach_grace_s=, prefix_sharing=); unset knobs
+    fall back to the ``llm_*`` config defaults.
+
+    ``prefill_replicas > 0`` disaggregates the two serving phases: a
+    sibling ``{name}-prefill`` pool (same engine config) runs chunked
+    prefill on dedicated replicas and ships the finished KV pages to
+    this deployment's decode replicas over the bulk transfer plane;
+    decode lanes never stall behind a long prompt.
 
     Usage::
 
@@ -937,5 +1353,6 @@ def llm_deployment(name: str = "llm", *, num_replicas: Any = 1,
                    autoscaling_config=dict(autoscaling_config)
                    if autoscaling_config else None,
                    llm=True, request_timeout_s=request_timeout_s,
-                   hedge_after_s=hedge_after_s, idempotent=idempotent)
+                   hedge_after_s=hedge_after_s, idempotent=idempotent,
+                   prefill_replicas=int(prefill_replicas))
     return d.bind(**engine_kwargs)

@@ -205,7 +205,19 @@ def test_summarize_tasks_percentiles_and_actor_methods(acct_cluster):
         rows = [v for k, v in tasks.items() if k.endswith("quick")]
         if not rows or not rows[0]["running"]:
             return False
-        return rows[0]["running"]["count"] >= 8
+        # the queued percentile needs the owner-side submitted_ts
+        # batch, which races the executor's RUNNING/FINISHED batch —
+        # wait for BOTH rows, not just running, before asserting
+        if not rows[0]["queued"] or rows[0]["queued"]["count"] < 1:
+            return False
+        if rows[0]["running"]["count"] < 8:
+            return False
+        # the actor-method counts ride their own event batches: wait
+        # until the store saw all 3 incr calls too, so every assertion
+        # below reads settled state instead of racing the flush
+        actors = state.summarize_actors()
+        return any(k.endswith("incr") and n >= 3
+                   for k, n in actors["methods"].items())
 
     _wait(summary_ready, what="task summary percentiles")
     tasks = state.summarize_tasks()

@@ -341,6 +341,7 @@ def test_metric_names_documented_in_readme(cluster):
                m.object_store_breakdown_gauge,
                m.pipeline_metrics,
                m.llm_metrics,
+               m.llm_prefix_metrics,
                m.autoscaler_metrics,
                m.serve_sheds_counter,
                m.deadline_metrics,

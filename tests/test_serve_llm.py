@@ -341,6 +341,194 @@ def test_loop_single_flight_and_stop():
     assert not t.is_alive()
 
 
+# --------------------------------------------- prefix sharing (CoW pages)
+
+
+def _gauge_value(state):
+    from ray_tpu._private.metrics import llm_metrics
+
+    pages_gauge = llm_metrics()[1]
+    for k, v in pages_gauge._values.items():
+        if ("state", state) in k:
+            return v
+    return None
+
+
+def test_prefix_sharing_decode_identity():
+    """The tentpole's correctness gate: a second sequence admitted onto
+    SHARED physical KV pages (full-page hits) plus a copy-on-write
+    split for a mid-page divergence decodes token-identically to the
+    teacher-forcing full forward."""
+    eng = _engine()
+    base = list(range(1, 25))  # 3 full pages at page_size=8
+    s1 = eng.submit({"tokens": base, "max_new_tokens": 6,
+                     "request_id": "p1"})
+    for _ in range(4):
+        eng.step()  # s1 past prefill: its pages are registered
+    assert len(eng._prefix_index) == 3
+    # identical prompt: 2 full shared pages + a CoW extension of 7
+    # tokens (one token always left to prefill for first-token logits)
+    s2 = eng.submit({"tokens": base, "max_new_tokens": 6,
+                     "request_id": "p2"})
+    # mid-page divergence: shares 2 full pages, CoW-copies 4 tokens
+    div = base[:20] + [60, 61, 62, 63]
+    eng.step()
+    s3 = eng.submit({"tokens": div, "max_new_tokens": 6,
+                     "request_id": "p3"})
+    _drain(eng)
+    st = eng.stats()
+    assert st["prefix_hits"] == 2 and st["cow_splits"] == 2, st
+    assert st["prefix_tokens_shared"] == 23 + 20, st
+    _assert_greedy(eng, base, s1.generated, n=6)
+    _assert_greedy(eng, base, s2.generated, n=6)
+    assert list(s1.generated) == list(s2.generated)
+    _assert_greedy(eng, div, s3.generated, n=6)
+    assert st["used_pages"] == 0 and st["free_pages"] == 32, st
+
+
+def test_prefix_sharing_flag_off():
+    eng = _engine(prefix_sharing=False)
+    base = list(range(1, 25))
+    s1 = eng.submit({"tokens": base, "max_new_tokens": 4})
+    for _ in range(4):
+        eng.step()
+    s2 = eng.submit({"tokens": base, "max_new_tokens": 4})
+    _drain(eng)
+    st = eng.stats()
+    assert st["prefix_hits"] == 0 and st["shared_pages"] == 0
+    assert list(s1.generated) == list(s2.generated)
+
+
+def test_shared_pages_recycle_only_at_refcount_zero():
+    """The refcount hard paths: with two sequences sharing prefix
+    pages, killing one — disconnect-cancel, mid-decode deadline
+    expiry, or abandoned-consumer death (the replica-OOM analogue:
+    the consumer process vanishes and the grace sweep fires) — must
+    NOT recycle the shared pages while the survivor decodes on them;
+    the kv-pages gauge returns to baseline only when BOTH are gone."""
+    from ray_tpu._private.errors import DeadlineExceededError
+
+    base = list(range(1, 25))
+
+    def run_pair(eng, kill, second_req=None):
+        eng._set_gauges()
+        free_baseline = _gauge_value("free")
+        s1 = eng.submit({"tokens": base, "max_new_tokens": 40,
+                         "request_id": "k1"})
+        for _ in range(4):
+            eng.step()
+        req2 = {"tokens": base, "max_new_tokens": 6,
+                "request_id": "k2", **(second_req or {})}
+        s2 = eng.submit(req2)
+        eng.step()
+        assert eng.stats()["prefix_hits"] == 1
+        shared = [p for p in s2.block_table
+                  if eng._page_refs[p] > 1]
+        assert shared, "second sequence landed on no shared pages"
+        assert eng.stats()["shared_pages"] == len(shared)
+        kill(eng, s1)  # first holder dies mid-decode
+        assert s1.done and s1.cancelled
+        for p in shared:
+            assert eng._page_refs[p] == 1, \
+                "shared page recycled while the survivor holds it"
+        _drain(eng)
+        assert s2.done and not s2.cancelled
+        _assert_greedy(eng, base, s2.generated, n=6)
+        st = eng.stats()
+        assert st["used_pages"] == 0 and st["shared_pages"] == 0, st
+        eng._set_gauges()
+        assert _gauge_value("free") == free_baseline, \
+            "kv pages gauge not back to baseline"
+
+    # disconnect-cancel (client dropped the stream)
+    run_pair(_engine(), lambda e, s: e.cancel("k1"))
+
+    # mid-decode deadline expiry (PR-13 sweep)
+    def expire(e, s):
+        s.deadline = time.time() - 0.01
+        e.step()  # sweep runs at step start
+        assert isinstance(s.error, DeadlineExceededError)
+
+    run_pair(_engine(), expire,
+             second_req={"deadline_ms": (time.time() + 60.0) * 1000.0})
+
+    # abandoned consumer past the grace window (replica-OOM analogue)
+    def abandon(e, s):
+        e.release(s)
+        time.sleep(0.08)
+        e.step()
+
+    run_pair(_engine(detach_grace_s=0.05), abandon)
+
+
+# ------------------------------------------------- disaggregated prefill
+
+
+def test_disagg_prefill_ship_attach_identity():
+    """Engine-level disaggregation: prefill_request on engine P
+    exports the KV pages, the pack/unpack wire format round-trips
+    byte-checksummed, and engine D attaches the shipped pages by
+    request_id, emits the shipped first token, and decodes
+    token-identically to the full forward — without ever running
+    prefill itself."""
+    from ray_tpu._private.object_transfer import (pack_kv_pages,
+                                                  unpack_kv_pages)
+
+    P = _engine()
+    D = _engine(params=P._params)
+    prompt = list(range(2, 21))  # 19 tokens -> 3 pages shipped
+    payload = P.prefill_request({"tokens": prompt, "max_new_tokens": 6,
+                                 "request_id": "ship1"})
+    assert payload["meta"]["n"] == len(prompt)
+    assert payload["meta"]["pages"] == 3
+    stp = P.stats()
+    assert stp["kv_pages_shipped_out"] == 3 and stp["used_pages"] == 0
+    # the wire format: magic + crc32 header, verified on unpack
+    buf = pack_kv_pages(payload["meta"], payload["rows"])
+    meta, rows = unpack_kv_pages(buf)
+    assert meta["first_token"] == payload["meta"]["first_token"]
+
+    s = D.submit({"tokens": prompt, "max_new_tokens": 6,
+                  "request_id": "ship1"}, kv_pack=(meta, rows))
+    _drain(D)
+    assert s.done and len(s.generated) == 6
+    # first generated token is the prefill replica's shipped token
+    assert s.generated[0] == meta["first_token"]
+    _assert_greedy(D, prompt, s.generated, n=6)
+    std = D.stats()
+    assert std["kv_pages_shipped_in"] == 3 and std["used_pages"] == 0
+
+
+def test_disagg_kv_pack_corruption_detected():
+    from ray_tpu._private.object_transfer import (TransferError,
+                                                  pack_kv_pages,
+                                                  unpack_kv_pages)
+
+    P = _engine()
+    payload = P.prefill_request({"tokens": [5, 9, 3, 7],
+                                 "max_new_tokens": 2})
+    buf = bytearray(pack_kv_pages(payload["meta"], payload["rows"]))
+    buf[len(buf) // 2] ^= 0xFF
+    with pytest.raises(TransferError):
+        unpack_kv_pages(bytes(buf))
+
+
+def test_disagg_mismatched_pack_falls_back_to_local_prefill():
+    """A shipment that does not describe the request's prompt is
+    discarded — the sequence prefills locally and still decodes
+    correctly (disaggregation must never be a correctness risk)."""
+    P = _engine()
+    D = _engine(params=P._params)
+    payload = P.prefill_request({"tokens": [5, 9, 3, 7],
+                                 "max_new_tokens": 2})
+    other = [1, 2, 3, 4, 5, 6]
+    s = D.submit({"tokens": other, "max_new_tokens": 4},
+                 kv_pack=(payload["meta"], payload["rows"]))
+    _drain(D)
+    _assert_greedy(D, other, s.generated, n=4)
+    assert D.stats()["kv_pages_shipped_in"] == 0
+
+
 # ------------------------------------------------- serve.batch timer fix
 
 
@@ -689,3 +877,126 @@ def test_llm_replica_death_resumes_stream(llm_cluster):
     assert sorted(seen) == list(range(n)), sorted(seen)[-5:]
     local = _engine()  # same seed: identical params for the oracle
     _assert_greedy(local, [5, 9, 3], [seen[i] for i in range(n)], n=n)
+
+
+# ----------------------------------- disaggregated prefill: e2e + chaos
+
+
+def test_disagg_kv_ship_survives_corrupt_transfer(tmp_path, monkeypatch):
+    """Acceptance E2E: a prompt prefilled on one engine (the prefill
+    replica) ships its packed KV pages over the bulk transfer plane;
+    the transfer is chaos-corrupted ONCE, caught by the seal-time CRC,
+    re-pulled from an alternate holder, unpacked (byte-checksummed wire
+    format), and attached on a second engine (the decode replica) —
+    whose decode is token-identical to the full forward."""
+    import asyncio
+    import uuid
+
+    from ray_tpu._private import fault_injection
+    from ray_tpu._private.head import HeadService
+    from ray_tpu._private.node_agent import NodeAgent
+    from ray_tpu._private.object_transfer import (pack_kv_pages,
+                                                  unpack_kv_pages)
+
+    P = _engine()
+    D = _engine(params=P._params)
+    prompt = list(range(2, 21))
+    payload = P.prefill_request({"tokens": prompt, "max_new_tokens": 6,
+                                 "request_id": "kvchaos"})
+    buf = pack_kv_pages(payload["meta"], payload["rows"])
+    MB = 1024 * 1024
+    # the tiny model's KV pack is a few tens of KB — below the default
+    # 1MB directory floor no holder would ever be announced, and the
+    # alternate-holder retry needs the directory to know both copies
+    monkeypatch.setenv("RT_LOCALITY_MIN_BYTES", "1024")
+
+    async def ship():
+        head = HeadService()
+        head_port = await head.start()
+        agents = []
+        for i in range(3):
+            ag = NodeAgent(("127.0.0.1", head_port), str(tmp_path),
+                           {"CPU": 1},
+                           arena_path=str(
+                               tmp_path /
+                               f"arena-{i}-{uuid.uuid4().hex[:6]}"),
+                           capacity=32 * MB)
+            await ag.start()
+            agents.append(ag)
+        a, b, c = agents
+        try:
+            loc = a.store.create("kvship", len(buf), primary=True)
+            if loc["location"] == "shm":
+                a.store.arena.view[loc["offset"]:loc["offset"] + len(buf)] \
+                    = buf
+            else:
+                with open(loc["path"], "r+b") as f:
+                    f.write(buf)
+            a.store.seal("kvship")
+            # a second holder so an alternate exists in the directory
+            r = await b.rpc_ensure_local("kvship", src=[a.host, a.port])
+            assert r.get("ok"), r
+            deadline = time.monotonic() + 10
+            while len(head.dir.locations("kvship")) < 2:
+                assert time.monotonic() < deadline, "no second holder"
+                await asyncio.sleep(0.05)
+            fault_injection.inject("xfer.send", "corrupt", count=1,
+                                   target="kvship")
+            r = await c.rpc_ensure_local("kvship")
+            assert r.get("ok"), r
+            assert c.xfer_stats["checksum_failures"] == 1
+            assert c.xfer_stats["alt_source_retries"] == 1
+            entry = c.store.objects["kvship"]
+            if entry.location == "shm":
+                return bytes(c.store.arena.view[
+                    entry.offset:entry.offset + len(buf)])
+            with open(entry.path, "rb") as f:
+                return f.read()
+        finally:
+            fault_injection.clear()
+            for ag in agents:
+                try:
+                    await ag.stop()
+                except Exception:
+                    pass
+            await head.stop()
+
+    data = asyncio.run(ship())
+    assert data == buf  # survived the corrupted transfer byte-exact
+    meta, rows = unpack_kv_pages(data)
+    s = D.submit({"tokens": prompt, "max_new_tokens": 6,
+                  "request_id": "kvchaos"}, kv_pack=(meta, rows))
+    _drain(D)
+    assert s.done and s.generated[0] == meta["first_token"]
+    _assert_greedy(D, prompt, s.generated, n=6)
+    assert D.stats()["kv_pages_shipped_in"] == 3
+
+
+def test_llm_disaggregated_prefill_serve_e2e(llm_cluster):
+    """llm_deployment(prefill_replicas=1) deploys TWO pools; an SSE
+    request's prefill phase runs on the dedicated pool (the handle's
+    prefill hop), its KV pages ship by kv_ref, and the decode replica
+    attaches them — token-identical to the local oracle, with the
+    shipped-page counters moving on both sides."""
+    h = llm_cluster["deploy"]("llm_disagg", prefill_replicas=1,
+                              detach_grace_s=5.0)
+    pf = serve.get_handle("llm_disagg-prefill")
+    prompt = list(range(3, 22))  # 19 tokens -> 3 shipped pages
+    conn, resp = _sse_request(llm_cluster["host"], llm_cluster["port"],
+                              "llm_disagg",
+                              {"tokens": prompt, "max_new_tokens": 6})
+    assert resp.status == 200
+    items = _read_items(resp)
+    conn.close()
+    flat = [(it["i"] + j, t) for it in items
+            for j, t in enumerate(it["tokens"])]
+    assert [i for i, _ in flat] == list(range(6))
+    local = _engine()  # same seed: identical params for the oracle
+    _assert_greedy(local, prompt, [t for _, t in flat], n=6)
+    # the decode replica imported the shipped pages instead of
+    # prefilling; the prefill replica exported them and recycled
+    std = ray_tpu.get(h.method("stats")(), timeout=30)
+    assert std["kv_pages_shipped_in"] >= 3, std
+    stp = ray_tpu.get(pf.method("stats")(), timeout=30)
+    assert stp["kv_pages_shipped_out"] >= 3, stp
+    assert stp["used_pages"] == 0 and std["used_pages"] == 0
