@@ -115,27 +115,108 @@ def _client_bench(address: str, n: int, ready_file: str = ""):
          "start": round(t0, 4), "end": round(t1, 4)}))
     ray_tpu.shutdown()
 
-def bench_head_scaling(ray_tpu, n=800, pairs=2):
-    """Head-scalability phase (ISSUE 8): aggregate multi-driver task
-    throughput at 2, 4, and 8 concurrent clients sharing one cluster.
-    Every client's lease requests, task-event flushes, and heartbeat-fed
-    directory traffic land on the same head/agent — this is the phase
-    that shows whether one control-plane structure is the ceiling.
-    Cycled BEST-OF ALTERNATING rounds per the slow-box protocol;
-    scaling_efficiency_pct is per-client throughput retained from 2 to
-    8 clients (100 * rate8 / (4 * rate2))."""
-    rates = {2: [], 4: [], 8: []}
+def _head_scaling_probe(ray_tpu):
+    """Best-effort head-side sample after a client-count round: the
+    sched-latency SLO p99 and per-shard ingest loop lag (the sharded
+    head's 'which plane is hot' signal)."""
+    try:
+        snap = ray_tpu.api._worker().head.call("autoscaler_snapshot",
+                                               timeout=15)
+    except Exception:
+        return None, {}
+    p99 = (snap.get("signals") or {}).get("sched_queued_p99_ms")
+    lags = {name: round(float(p.get("lag_s", 0.0)) * 1000.0, 3)
+            for name, p in ((snap.get("shards") or {}).get("planes")
+                            or {}).items()}
+    return p99, lags
+
+def bench_head_scaling(ray_tpu, n=800, pairs=2, counts=(2, 4, 8, 16),
+                       probe=True):
+    """Head-scalability phase (ISSUE 8, extended by ISSUE 18): aggregate
+    multi-driver task throughput at 2..16 concurrent clients sharing one
+    cluster.  Every client's lease requests, task-event flushes, and
+    heartbeat-fed directory traffic land on the same head/agent — this
+    is the phase that shows whether one control-plane structure is the
+    ceiling.  Cycled BEST-OF ALTERNATING rounds per the slow-box
+    protocol; scaling_efficiency_pct is per-client throughput retained
+    from 2 to 8 clients (100 * rate8 / (4 * rate2)).  Also emits the
+    sched_p99_ms_by_clients curve and per-shard ingest loop lag sampled
+    right after each client count's best round."""
+    rates = {c: [] for c in counts}
+    p99_curve = {}
+    shard_lag = {}
     for _ in range(pairs):
-        for c in (2, 4, 8):
+        for c in counts:
             rates[c].append(bench_multi_client(ray_tpu, clients=c, n=n))
+            if probe:
+                p99, lags = _head_scaling_probe(ray_tpu)
+                if p99 is not None:
+                    p99_curve[str(c)] = p99
+                if lags:
+                    shard_lag = lags
     best = {c: max(v) for c, v in rates.items()}
-    eff = 100.0 * best[8] / (4 * best[2]) if best[2] > 0 else 0.0
-    return {
+    eff = 100.0 * best[8] / (4 * best[2]) if best.get(2) else 0.0
+    out = {
         "multi_client_2_tasks_per_s": round(best[2], 1),
-        "multi_client_4_tasks_per_s": round(best[4], 1),
         "multi_client_tasks_per_s": round(best[8], 1),
         "scaling_efficiency_pct": round(eff, 1),
     }
+    if 4 in best:
+        out["multi_client_4_tasks_per_s"] = round(best[4], 1)
+    if 16 in best:
+        out["multi_client_16_tasks_per_s"] = round(best[16], 1)
+        out["scaling_efficiency_16_pct"] = round(
+            100.0 * best[16] / (8 * best[2]), 1) if best.get(2) else 0.0
+    if p99_curve:
+        out["sched_p99_ms_by_clients"] = p99_curve
+    if shard_lag:
+        out["head_shard_loop_lag_ms"] = shard_lag
+    return out
+
+def _head_scaling_ab_bench(shards: int):
+    """Runs as a subprocess: its OWN cluster with RT_HEAD_INGEST_SHARDS
+    pinned, a reduced 2/8-client ladder, one JSON line out — the
+    single-loop (shards=0) side of the head scale-out A/B.  The main
+    phase's numbers come from the default (sharded) head; this run is
+    the control."""
+    os.environ["RT_HEAD_INGEST_SHARDS"] = str(shards)
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4),
+                 object_store_memory=256 * 1024 * 1024)
+    try:
+        out = bench_head_scaling(ray_tpu, pairs=2, counts=(2, 8),
+                                 probe=False)
+        print("HEADSCALEJSON " + json.dumps({
+            "head_ingest_shards": shards,
+            "multi_client_2_tasks_per_s":
+                out["multi_client_2_tasks_per_s"],
+            "multi_client_tasks_per_s": out["multi_client_tasks_per_s"],
+            "scaling_efficiency_pct": out["scaling_efficiency_pct"],
+        }))
+    finally:
+        ray_tpu.shutdown()
+
+def bench_head_scaling_single_loop_ab():
+    """The A/B control: the same multi-client ladder against a
+    single-loop head (head_ingest_shards=0) in a subprocess cluster.
+    Keys are suffixed _single_loop; scaling_efficiency_vs_single_loop_x
+    is the headline ratio (> 1 = the shards pay for themselves)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--head-scaling-bench", "0"],
+        capture_output=True, text=True, timeout=600, cwd=REPO)
+    for line in proc.stdout.splitlines():
+        if line.startswith("HEADSCALEJSON "):
+            r = json.loads(line[len("HEADSCALEJSON "):])
+            return {
+                "multi_client_tasks_per_s_single_loop":
+                    r["multi_client_tasks_per_s"],
+                "scaling_efficiency_pct_single_loop":
+                    r["scaling_efficiency_pct"],
+            }
+    raise RuntimeError(
+        f"head-scaling A/B rc={proc.returncode}: {proc.stderr[-400:]}")
 
 def bench_multi_client(ray_tpu, clients=3, n=1000):
     """Aggregate throughput with several concurrent DRIVER processes
@@ -1880,6 +1961,17 @@ def main():
         except Exception as exc:  # noqa: BLE001
             errors["shutdown"] = f"{type(exc).__name__}: {exc}"[:300]
 
+    # head scale-out A/B control: the same 2/8-client ladder against a
+    # single-loop head (head_ingest_shards=0) in its own subprocess
+    # cluster, after shutdown so both sides of the comparison owned the
+    # whole box; the sharded side is the head_scaling phase above
+    phase("head_scaling_single_loop", lambda: extras.update(
+        bench_head_scaling_single_loop_ab()))
+    if extras.get("scaling_efficiency_pct_single_loop"):
+        extras["scaling_efficiency_vs_single_loop_x"] = round(
+            extras.get("scaling_efficiency_pct", 0.0)
+            / extras["scaling_efficiency_pct_single_loop"], 2)
+
     # post-shutdown phases: the object-plane pair runs its own
     # in-process agents and the locality workload its own subprocess
     # cluster — neither shares state with the main cluster above
@@ -1943,6 +2035,10 @@ if __name__ == "__main__":
     elif "--oom-bench" in sys.argv:
         sys.path.insert(0, REPO)
         _oom_bench()
+    elif "--head-scaling-bench" in sys.argv:
+        sys.path.insert(0, REPO)
+        i = sys.argv.index("--head-scaling-bench")
+        _head_scaling_ab_bench(int(sys.argv[i + 1]))
     elif "--client-bench" in sys.argv:
         sys.path.insert(0, REPO)
         i = sys.argv.index("--client-bench")

@@ -120,6 +120,16 @@ _def("object_checksums", True)
 # long — bounded further by the ambient deadline — for room before
 # taking the disk-fallback path; 0 restores immediate fallback
 _def("put_backpressure_max_s", 10.0)
+# --- head control-plane sharding (see _private/head_shards.py) ---------------
+# ingest event-loop threads beside the head's scheduling loop:
+#   0 = single-loop compat (planes run on the head loop, no threads)
+#   1 = one shared ingest loop for both planes
+#   2 = task-event loop + telemetry loop (the default topology)
+_def("head_ingest_shards", 2)
+# task-event inbox bound, in FRAMES: past this the oldest queued frame
+# drops (counted in ray_tpu_task_events_dropped_total{shard=...}) so a
+# runaway burst cannot grow head memory without bound; 0 = unbounded
+_def("head_inbox_max_frames", 4096)
 # --- observability ----------------------------------------------------------
 _def("task_events_buffer_size", 10_000)
 _def("metrics_report_interval_ms", 5_000)

@@ -70,6 +70,8 @@ style="color:var(--muted);font-size:12px"></span>
 <main>
 <div id="error"></div>
 <div class="tiles" id="tiles"></div>
+<div id="shards" style="color:var(--muted);font-size:12px;
+padding:2px 0 6px"></div>
 <nav class="tabs" id="tabs"></nav>
 <div id="view"></div>
 </main>
@@ -334,6 +336,21 @@ function render() {
     tile("tasks finished / 10s", s.summary.task_rate,
          s.series.map(p => p.task_rate)),
   );
+  // head ingest shard topology + per-loop lag (shards: 0 = single-loop
+  // compat mode, every plane rides the scheduling loop)
+  const sh = s.shards || {count: 0, planes: {}};
+  const shardLine = document.getElementById("shards");
+  if (sh.count > 0) {
+    const parts = Object.entries(sh.planes).map(([name, p]) =>
+      `${name}: ${p.own_thread ? "own loop" : "head loop"}` +
+      ` lag ${((p.lag_s || 0) * 1000).toFixed(1)}ms` +
+      (p.dropped ? ` dropped ${p.dropped}` : ""));
+    shardLine.textContent =
+      `head ingest shards: ${sh.count} — ` + parts.join(" · ");
+  } else {
+    shardLine.textContent =
+      "head ingest shards: 0 (single-loop compat)";
+  }
   const tabs = document.getElementById("tabs");
   tabs.replaceChildren(...TABS.map(([id, label]) => {
     const counts = {nodes: s.nodes.length, actors: s.actors.length,

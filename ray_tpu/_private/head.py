@@ -229,23 +229,23 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         # /api/autoscaler and the `rtpu status` autoscaler pane
         self._drains: Dict[str, Dict[str, Any]] = {}
         self._autoscaler_status: Dict[str, Any] = {}
-        # task-event store: merged record per task, insertion-ordered so
-        # the oldest fall off at the cap (reference: gcs_task_manager.h).
-        # Incoming frames queue in _ev_inbox and merge once per loop
-        # tick (see rpc_task_events)
-        self.task_events: Dict[str, Dict[str, Any]] = {}
-        self._ev_inbox: List[List[Dict[str, Any]]] = []
-        self._ev_drain_scheduled = False
-        # trace store: trace_id -> {spans, start, end, root}, insertion-
-        # ordered and bounded like the task-event store (see tracing.py)
-        self.traces: Dict[str, Dict[str, Any]] = {}
-        self._trace_spans_dropped = 0
-        # task_id -> set of scheduler-latency phases already observed
-        # into the histogram (each phase observed once per task; phases
-        # complete incrementally because owner and executor flush their
-        # halves of the timestamps on independent clocks)
-        self._sched_observed: Dict[str, set] = {}
-        self._sched_hist = None  # created in _start_metrics
+        # control-plane ingest shards (head_shards.py): the task-event
+        # plane owns the task-event store + trace store + sched-latency
+        # feed; the telemetry plane owns heartbeat ingest + the time-
+        # series ring.  Constructed in start() (the compat topology
+        # wraps the running loop); head_ingest_shards=0 keeps every
+        # plane on this loop.  The membership snapshot is the core ->
+        # shard handshake: republished synchronously with every
+        # cluster/chaos/quarantine mutation, read lock-free by the
+        # telemetry plane when assembling heartbeat replies.
+        from ray_tpu._private.head_shards import VersionedSnapshot
+
+        self.shards = None
+        self._ev_plane = None
+        self._telem = None
+        self._core_queue = None
+        self._membership = VersionedSnapshot(payload=None)
+        self._core_inbox_gauge = None
         self._metrics_server = None
         self.metrics_port = 0
         # pending-PG replan wakeups: futures resolved whenever cluster
@@ -266,10 +266,6 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
 
         self._dash_series = _deque(maxlen=150)
         self._dash_task: Optional[asyncio.Task] = None
-        # time-series store: (node, metric) -> bounded ring of (ts, value)
-        # fed by per-agent heartbeat summaries + the head's own sampler,
-        # served at /api/timeseries and `rtpu status --watch`
-        self._tseries: Dict[Tuple[str, str], Any] = {}
         self._head_loop_lag = 0.0
         self._lag_task: Optional[asyncio.Task] = None
         # chaos fault-injection rules (fault_injection.py): the head is
@@ -278,9 +274,9 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         # catch-up, version-gated like the object directory)
         self._chaos_rules: List[Dict[str, Any]] = []
         self._chaos_version = 0
-        # node_id -> {rule_id: fired} from heartbeats (current version
-        # only); status aggregates these with the head's own counts
-        self._chaos_fired: Dict[str, Dict[str, int]] = {}
+        # per-node chaos firing counts now live on the telemetry plane
+        # (heartbeats land there); status aggregates them with the
+        # head's own counts via _telem.chaos_fired_counts()
         # poison-task quarantine: fid -> {kills, history, until, name,
         # detail}.  Owners report each worker kill their class caused
         # (task_kill_report) and the first success after one
@@ -319,6 +315,26 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         if self._state_path:
             self._load_state()
+        # ingest shards before the server: routed ops (task_events,
+        # heartbeat, ...) dispatch onto their loops from the very first
+        # frame.  The cross-shard queue is the telemetry plane's only
+        # write path into core state (_NodeEntry mutations), drained
+        # once per core tick.
+        from ray_tpu._private.head_shards import (CrossShardQueue,
+                                                  HeadShards,
+                                                  TaskEventPlane,
+                                                  TelemetryPlane)
+
+        core_loop = asyncio.get_running_loop()
+        self.shards = HeadShards(int(config.head_ingest_shards), core_loop)
+        self._core_queue = CrossShardQueue(
+            core_loop, self._apply_node_updates, name="telemetry")
+        self._ev_plane = TaskEventPlane(self.shards.task_events)
+        self._telem = TelemetryPlane(self.shards.telemetry, self.dir,
+                                     self._membership, self._core_queue)
+        self.rpc_op_loops = self.shards.op_loops()
+        self.shards.start()
+        self._publish_membership()
         self._server = RpcServer(self, host, port)
         p = await self._server.start()
         self._health_task = asyncio.ensure_future(self._health_loop())
@@ -368,6 +384,8 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
             self._metrics_server.close()
         if self._server:
             await self._server.stop()
+        if self.shards is not None:
+            self.shards.stop()
         self._shutdown.set()
 
     # ---- persistence -------------------------------------------------------
@@ -542,11 +560,70 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                 "dir_epoch": self.dir.epoch,
                 "dir": self.dir.updates_since(None)}
 
+    def _publish_membership(self) -> None:
+        """Publish the scheduling core's membership snapshot for the
+        telemetry plane: node identity/addr/labels/totals/draining plus
+        the version-gated gossip payloads (chaos, quarantine, scalable
+        shapes).  Republished synchronously with EVERY mutation of that
+        state, so the plane's heartbeat replies are stale by at most
+        the in-flight beats of one publish — the DirectoryMirror
+        version-handshake pattern, core->shard direction."""
+        if self._membership is None:
+            return
+        nodes: Dict[str, Dict[str, Any]] = {}
+        for nid, n in self.nodes.items():
+            nodes[nid] = {"addr": [n.host, n.port], "labels": n.labels,
+                          "xfer": n.xfer_port, "draining": n.draining,
+                          "is_head": n.is_head_node,
+                          "total": n.resources.total.to_dict(),
+                          "available": n.resources.available.to_dict(),
+                          "pressure": n.pressure}
+        self._membership.publish({
+            "nodes": nodes,
+            "version": self._cluster_version,
+            "scalable": self._scalable_shapes(),
+            "chaos_version": self._chaos_version,
+            "chaos_payload": self._chaos_payload(),
+            "quarantine_version": self._quarantine_version,
+            "quarantine_payload": self._quarantine_payload(),
+        })
+
+    def _apply_node_updates(self, items: List[Dict[str, Any]]) -> None:
+        """Core-loop drain of the telemetry plane's cross-shard queue:
+        fold heartbeat-derived per-node state into the scheduling
+        core's _NodeEntry records (availability for placement, pending
+        demand for the autoscaler, liveness for the health loop).  One
+        callback per core tick regardless of how many beats landed."""
+        woke = False
+        for up in items:
+            entry = self.nodes.get(up["node_id"])
+            if entry is None:
+                continue
+            entry.last_heartbeat = up["hb_mono"]
+            if up.get("memory"):
+                entry.memory = up["memory"]
+            if up.get("pressure") is not None:
+                entry.pressure = float(up["pressure"])
+            fresh = ResourceSet(up.get("available") or {})
+            if fresh != entry.resources.available:
+                woke = True
+            entry.resources.available = fresh
+            entry.pending_demands = up.get("pending") or []
+        if woke:
+            self._wake_pending_pgs()
+        if self._core_inbox_gauge is None:
+            from ray_tpu._private.metrics import head_inbox_depth_gauge
+
+            self._core_inbox_gauge = head_inbox_depth_gauge()
+        self._core_inbox_gauge.set(self._core_queue.take_high_water(),
+                                   tags={"shard": "telemetry"})
+
     def _broadcast_cluster_view(self):
         """Membership changed: push the fresh view to every agent so
         feasibility checks don't wait out a heartbeat period (equivalent
         of the reference's ray_syncer broadcast).  One task per peer so a
         wedged agent can't stall the others."""
+        self._publish_membership()
         view = self._cluster_view()
         version = self._cluster_version
         scalable = self._scalable_shapes()
@@ -574,53 +651,19 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                             seen_chaos_version: int = 0,
                             seen_quarantine_version: int = 0,
                             chaos_fired: Optional[Dict[str, int]] = None):
-        entry = self.nodes.get(node_id)
-        if entry is None:
-            return {"unknown_node": True}
-        entry.last_heartbeat = time.monotonic()
-        if memory:
-            entry.memory = memory
-        if pressure is not None:
-            entry.pressure = float(pressure)
-        if metrics:
-            now = time.time()
-            for name, value in metrics.items():
-                self._ts_record(node_id[:12], str(name), value, now)
-        fresh = ResourceSet(available)
-        changed = fresh != entry.resources.available
-        entry.resources.available = fresh
-        entry.pending_demands = pending or []
-        if objects_delta is not None:
-            # delta vs what this agent last acked — applied per shard,
-            # bumping only the touched shards' versions.  A delta built
-            # against a stale epoch (head restarted underneath the
-            # agent) is only safe if it is a full re-send; otherwise the
-            # epoch in our reply makes the agent re-send everything.
-            if objects_delta.get("full") \
-                    or objects_delta.get("epoch") == self.dir.epoch:
-                self.dir.apply_delta(
-                    node_id, objects_delta.get("add") or (),
-                    objects_delta.get("remove") or (),
-                    full=bool(objects_delta.get("full")))
-        if changed:
-            self._wake_pending_pgs()
-        reply = {"cluster": self._cluster_view(),
-                 "version": self._cluster_version,
-                 "dir_epoch": self.dir.epoch,
-                 "dir": self.dir.updates_since(dir_versions),
-                 "scalable": self._scalable_shapes()}
-        if seen_chaos_version != self._chaos_version:
-            # catch-up for agents that missed the chaos_rules push (late
-            # join, agent restart, dropped connection)
-            reply["chaos"] = self._chaos_payload()
-        elif chaos_fired:
-            # counts only make sense against the CURRENT rule set
-            self._chaos_fired[node_id] = dict(chaos_fired)
-        if self._poison:
-            self._prune_quarantine()
-        if seen_quarantine_version != self._quarantine_version:
-            reply["quarantine"] = self._quarantine_payload()
-        return reply
+        """Routed to the telemetry shard's loop (rpc_op_loops): the
+        whole beat — directory delta application, gauge-summary ring
+        append, reply assembly off the membership snapshot — runs off
+        the scheduling loop.  Only the per-node core state (entry
+        availability/liveness) crosses back, over the single-producer
+        queue drained once per core tick (_apply_node_updates)."""
+        return self._telem.heartbeat(
+            node_id=node_id, available=available, pending=pending,
+            objects_delta=objects_delta, dir_versions=dir_versions,
+            metrics=metrics, memory=memory, pressure=pressure,
+            seen_chaos_version=seen_chaos_version,
+            seen_quarantine_version=seen_quarantine_version,
+            chaos_fired=chaos_fired)
 
     async def rpc_object_locations(self, oids: List[str]):
         """Directory lookup: which nodes' stores hold each oid (per the
@@ -1015,14 +1058,15 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
             raise RpcError(f"unknown chaos op {op!r}")
         if op != "status":
             self._chaos_version += 1
-            self._chaos_fired.clear()  # counts restart with the rule set
+            # counts restart with the rule set
+            self._telem.clear_chaos_fired()
             fault_injection.install(self._chaos_rules, self._chaos_version)
             self._broadcast_chaos()
             self._maybe_chaos_die()
         # aggregate cluster-wide firing counts: the head's own process
         # plus the latest per-agent heartbeat reports
         fired: Dict[str, int] = dict(fault_injection.fired_counts())
-        for counts in self._chaos_fired.values():
+        for counts in self._telem.chaos_fired_counts().values():
             for rid, n in counts.items():
                 fired[rid] = fired.get(rid, 0) + int(n)
         rules = [dict(r, fired=fired.get(r.get("rule_id", ""), 0))
@@ -1045,7 +1089,7 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         import signal
 
         delay = max(chaos.delay_s, 0.2)
-        asyncio.get_event_loop().call_later(
+        asyncio.get_running_loop().call_later(
             delay, lambda: os.kill(os.getpid(), signal.SIGKILL))
 
     # ---- poison-task quarantine --------------------------------------------
@@ -1070,6 +1114,7 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         if expired:
             self._quarantine_version += 1
             self._set_quarantine_gauge()
+            self._publish_membership()
 
     def _set_quarantine_gauge(self) -> None:
         from ray_tpu._private.metrics import memory_pressure_metrics
@@ -1126,6 +1171,7 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                 f"expires in {ttl:.0f}s or `rtpu quarantine clear`")
             self._quarantine_version += 1
             self._set_quarantine_gauge()
+            self._publish_membership()
             self.publish("error_info", {"kind": "task_quarantined",
                                         "key": key, "name": ent["name"],
                                         "detail": ent["detail"]})
@@ -1154,6 +1200,7 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
             if cleared:
                 self._quarantine_version += 1
                 self._set_quarantine_gauge()
+                self._publish_membership()
             return {"cleared": cleared}
         if op != "list":
             raise RpcError(f"unknown quarantine op {op!r}")
@@ -1171,6 +1218,9 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                 "version": self._chaos_version}
 
     def _broadcast_chaos(self) -> None:
+        # keep the telemetry plane's heartbeat catch-up in sync with
+        # the push: the membership snapshot carries the chaos payload
+        self._publish_membership()
         payload = self._chaos_payload()
 
         async def _push_one(conn):
@@ -1214,14 +1264,20 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                 n = self.nodes.get(nid)
                 if n is not None and now - n.last_heartbeat > threshold:
                     await self._on_node_dead(nid, "heartbeat timeout")
+            # quarantine TTL expiry used to ride the heartbeat path;
+            # beats now land on the telemetry shard, which must not
+            # mutate quarantine state — the core sweeps instead.
+            # Agents enforce TTLs locally (_quarantined_entry), so the
+            # one-period expiry-gossip latency is harmless.
+            if self._poison:
+                self._prune_quarantine()
 
     async def _on_node_dead(self, node_id: str, reason: str):
         entry = self.nodes.pop(node_id, None)
         if entry is None:
             return
-        for key in [k for k in self._tseries if k[0] == node_id[:12]]:
-            self._tseries.pop(key, None)  # dead node: drop its series
-        self._chaos_fired.pop(node_id, None)  # and its chaos counts
+        # dead node: drop its time series, chaos counts and telemetry
+        self._telem.drop_node(node_id)
         self.dir.drop_node(node_id)  # its object copies died with it
         self._cluster_version += 1
         self.mark_dirty()
@@ -2063,6 +2119,9 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
             "task scheduling latency by phase",
             boundaries=[0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1,
                         5, 30])
+        # fed by the task-event plane on its own loop (Histogram is
+        # internally locked, so cross-thread observes are safe)
+        self._ev_plane.sched_hist = self._sched_hist
 
         from ray_tpu._private.metrics import autoscaler_metrics
 
@@ -2089,8 +2148,9 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                 pstates[p.state] = pstates.get(p.state, 0) + 1
             for s, n in pstates.items():
                 pgs_g.set(n, tags={"state": s})
-            tasks_g.set(len(self.task_events))
-            traces_g.set(len(self.traces))
+            ev = self._ev_plane.stats.payload
+            tasks_g.set(ev["num_events"])
+            traces_g.set(ev["num_traces"])
 
         default_registry.add_collector(collect)
         try:
@@ -2147,7 +2207,7 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
             "nodes": [n.table_entry() for n in self.nodes.values()],
             "actors_by_state": actors,
             "num_placement_groups": len(self.placement_groups),
-            "num_task_events": len(self.task_events),
+            "num_task_events": self._ev_plane.stats.payload["num_events"],
             "kv_keys": len(self.kv),
         }
 
@@ -2169,8 +2229,10 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         return avail, total
 
     def _tasks_finished_total(self) -> int:
-        return sum(1 for r in self.task_events.values()
-                   if r.get("state") in ("FINISHED", "FAILED"))
+        # monotonic terminal-transition count published by the task-
+        # event plane — unlike the old store walk it cannot dip when
+        # old records roll off the cap
+        return int(self._ev_plane.stats.payload["finished_total"])
 
     async def _dash_sample_loop(self):
         """Every 2s append one sample to the sparkline ring (~5 min),
@@ -2189,26 +2251,35 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                     "cpus_avail": avail,
                     "actors_alive": sum(1 for a in self.actors.values()
                                         if a.state == ALIVE),
-                    # events roll off the capped store, so the delta can
-                    # dip negative on truncation — clamp
                     "task_rate": task_rate,
                 })
                 last_finished = finished
                 now = time.time()
-                self._ts_record("head", "loop_lag_seconds",
-                                self._head_loop_lag, now)
-                self._ts_record("head", "nodes", len(self.nodes), now)
-                self._ts_record("head", "cpus_avail", avail, now)
-                self._ts_record("head", "task_rate", task_rate, now)
+                ts = self._telem.ts_record
+                ts("head", "loop_lag_seconds", self._head_loop_lag, now)
+                ts("head", "nodes", len(self.nodes), now)
+                ts("head", "cpus_avail", avail, now)
+                ts("head", "task_rate", task_rate, now)
+                if self.shards is not None and self.shards.sharded:
+                    # per-shard ingest-loop lag beside the head's own:
+                    # `rtpu status --watch` sparklines show which plane
+                    # is hot without a metrics scrape
+                    ts("head", "shard_lag_task_events",
+                       self.shards.task_events.loop_lag, now)
+                    ts("head", "shard_lag_telemetry",
+                       self.shards.telemetry.loop_lag, now)
             except Exception:
                 pass
 
-    def _render_snapshot_json(self):
+    async def _render_snapshot_json(self):
         import json as _json
 
-        recent = sorted(self.task_events.values(),
-                        key=lambda r: r.get("running_ts")
-                        or r.get("submitted_ts") or 0, reverse=True)[:200]
+        # record/trace copies are made ON the task-event plane's loop
+        # (run_sync) — its merge mutates records in place, so reading
+        # live dicts from this loop could tear mid-serialization
+        recent, traces = await self.shards.task_events.run_sync(
+            lambda: (self._ev_plane.recent_records(200),
+                     self._ev_plane.trace_store.summaries(50)))
         jobs = []
         try:
             idx = self.kv.get("job:index")
@@ -2226,9 +2297,10 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
             "placement_groups": [p.info(self.nodes)
                                  for p in self.placement_groups.values()],
             "jobs": jobs,
-            "traces": self._trace_summaries(50),
+            "traces": traces,
             "series": list(self._dash_series),
             "autoscaler": self._autoscaler_view(),
+            "shards": self._shard_info(),
             "summary": {
                 "cpus_avail": round(avail, 2), "cpus_total": round(total, 2),
                 "actors_alive": sum(1 for a in self.actors.values()
@@ -2239,7 +2311,31 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         }
         return "application/json", _json.dumps(snap, default=str).encode()
 
-    def _render_timeline_json(self):
+    def _shard_info(self) -> Dict[str, Any]:
+        """Shard topology + per-loop lag for the dashboard and `rtpu
+        status`: which ingest planes exist, whether they run on their
+        own threads, and how laggy each loop currently is."""
+        if self.shards is None:
+            return {"count": 0, "planes": {}}
+        ev = self._ev_plane.stats.payload
+        return {
+            "count": self.shards.count,
+            "planes": {
+                "task_events": {
+                    "own_thread": self.shards.task_events.own_thread,
+                    "lag_s": round(self.shards.task_events.loop_lag, 4),
+                    "events": ev["num_events"],
+                    "dropped": ev["dropped_total"],
+                },
+                "telemetry": {
+                    "own_thread": self.shards.telemetry.own_thread,
+                    "lag_s": round(self.shards.telemetry.loop_lag, 4),
+                    "dir_version_total": self.dir.version_total(),
+                },
+            },
+        }
+
+    async def _render_timeline_json(self):
         """Chrome-trace events straight off the task-event store (same
         shape as util.state.timeline / `rtpu timeline`): duration
         slices, submit→execute flow arrows, and instant events for
@@ -2248,180 +2344,67 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
 
         from ray_tpu.util.state.api import task_timeline_events
 
-        events = task_timeline_events(list(self.task_events.values()))
+        records = await self.shards.task_events.run_sync(
+            self._ev_plane.all_records)
+        events = task_timeline_events(records)
         return "application/json", _json.dumps(events).encode()
 
     async def rpc_task_events(self, events: List[Dict[str, Any]]):
         """Workers flush task state transitions here in batches
         (reference: task_event_buffer.h -> gcs_task_manager.h).
 
-        Frames land in an inbox drained ONCE per loop tick: with many
-        clients flushing a burst simultaneously, the merge + cap-trim +
-        latency-histogram pass runs over all of them together instead
-        of per frame — the head-side half of the event batching."""
-        self._ev_inbox.append(events)
-        if not self._ev_drain_scheduled:
-            self._ev_drain_scheduled = True
-            asyncio.get_running_loop().call_soon(self._drain_task_events)
+        Routed to the task-event shard's loop (rpc_op_loops): frames
+        land in the plane's inbox and merge ONCE per loop tick — with
+        many clients flushing a burst simultaneously, the merge +
+        cap-trim + latency-histogram pass runs over all of them
+        together, and none of it touches the scheduling loop."""
+        self._ev_plane.ingest(events)
         return {"ok": True}
-
-    def _drain_task_events(self) -> None:
-        self._ev_drain_scheduled = False
-        batches, self._ev_inbox = self._ev_inbox, []
-        for events in batches:
-            self._apply_task_events(events)
-        cap = config.task_events_buffer_size
-        while len(self.task_events) > cap:
-            oldest = next(iter(self.task_events))
-            self.task_events.pop(oldest)
-            self._sched_observed.pop(oldest, None)
-
-    def _apply_task_events(self, events: List[Dict[str, Any]]) -> None:
-        rank = {"SUBMITTED": 0, "LEASED": 1, "RUNNING": 2,
-                "FINISHED": 3, "FAILED": 3}
-        for ev in events:
-            tid = ev.get("task_id", "")
-            if not tid:
-                continue
-            rec = self.task_events.get(tid)
-            if rec is None:
-                rec = self.task_events[tid] = {"task_id": tid}
-            for k, v in ev.items():
-                if v is None:
-                    continue
-                if k == "state":
-                    # owner (SUBMITTED/LEASED) and executor (RUNNING/...)
-                    # flush on independent clocks; a late-arriving earlier
-                    # state must not regress the record
-                    if rank.get(v, 0) < rank.get(rec.get("state"), -1):
-                        continue
-                rec[k] = v
-            self._observe_sched_latency(rec)
-
-    def _observe_sched_latency(self, rec: Dict[str, Any]) -> None:
-        """Once a task record is terminal, decompose its lifetime into
-        queued→leased→running→finished phase durations and feed the
-        ray_tpu_task_sched_latency_seconds histogram.
-
-        Each phase is observed at most once per task, but independently:
-        the executor's RUNNING/FINISHED batch usually lands before the
-        owner's SUBMITTED/LEASED batch (the owner holds non-terminal
-        events for its periodic flush), so the queued/leased phases only
-        become computable on a later merge.  Negative deltas (events
-        stamped by different process clocks) clamp to 0."""
-        if self._sched_hist is None:
-            return
-        if rec.get("state") not in ("FINISHED", "FAILED"):
-            return
-        done = self._sched_observed.setdefault(rec.get("task_id", ""), set())
-        sub = rec.get("submitted_ts")
-        leased = rec.get("leased_ts")
-        run = rec.get("running_ts")
-        end = rec.get("finished_ts") or rec.get("failed_ts")
-        h = self._sched_hist
-        if "queued" not in done and sub is not None and leased is not None:
-            done.add("queued")
-            h.observe(max(0.0, leased - sub), tags={"phase": "queued"})
-        if "leased" not in done and leased is not None and run is not None:
-            done.add("leased")
-            h.observe(max(0.0, run - leased), tags={"phase": "leased"})
-        if "running" not in done and run is not None and end is not None:
-            done.add("running")
-            h.observe(max(0.0, end - run), tags={"phase": "running"})
 
     async def rpc_list_tasks(self, state: str = "", name: str = "",
                              limit: int = 1000):
-        out = []
-        for rec in reversed(list(self.task_events.values())):
-            if state and rec.get("state") != state:
-                continue
-            if name and rec.get("name") != name:
-                continue
-            out.append(rec)
-            if len(out) >= limit:
-                break
-        return {"tasks": out}
+        # routed to the task-event shard: reads see a store no merge is
+        # concurrently mutating, and the walk costs the scheduling loop
+        # nothing
+        return {"tasks": self._ev_plane.list_tasks(state, name, limit)}
 
-    # ---- distributed-trace store (see _private/tracing.py; reference:
-    # ray.util.tracing exports spans to an external collector — here a
-    # bounded in-head store queryable via RPC, HTTP and CLI) ---------------
+    # ---- distributed-trace store (see tracing.TraceStore, owned by the
+    # task-event plane; reference: ray.util.tracing exports spans to an
+    # external collector — here a bounded in-head store queryable via
+    # RPC, HTTP and CLI) ---------------------------------------------------
 
     async def rpc_trace_spans(self, spans: List[Dict[str, Any]]):
-        """Workers flush finished spans here alongside task events."""
-        max_traces = config.trace_store_max_traces
-        max_spans = config.trace_store_max_spans
-        for s in spans:
-            trace_id = s.get("trace_id")
-            if not trace_id:
-                continue
-            ent = self.traces.get(trace_id)
-            if ent is None:
-                while len(self.traces) >= max_traces:
-                    self.traces.pop(next(iter(self.traces)))
-                ent = self.traces[trace_id] = {
-                    "trace_id": trace_id, "spans": [],
-                    "start": s.get("start", 0.0), "end": 0.0, "root": "",
-                }
-            if len(ent["spans"]) >= max_spans:
-                self._trace_spans_dropped += 1
-                continue
-            ent["spans"].append(s)
-            start = s.get("start") or 0.0
-            if start and (not ent["start"] or start < ent["start"]):
-                ent["start"] = start
-            ent["end"] = max(ent["end"], s.get("end") or 0.0)
-            if not s.get("parent_id"):
-                ent["root"] = s.get("name", "")
+        """Workers flush finished spans here alongside task events
+        (routed to the same shard loop, so span ingest and event merge
+        never interleave mid-structure)."""
+        self._ev_plane.ingest_spans(spans)
         return {"ok": True}
 
-    def _trace_summary(self, ent: Dict[str, Any]) -> Dict[str, Any]:
-        return {
-            "trace_id": ent["trace_id"],
-            "num_spans": len(ent["spans"]),
-            "root": ent.get("root", ""),
-            "start": ent.get("start", 0.0),
-            "end": ent.get("end", 0.0),
-            "duration_s": max(0.0, (ent.get("end") or 0.0)
-                              - (ent.get("start") or 0.0)),
-        }
-
-    def _trace_summaries(self, limit: int) -> List[Dict[str, Any]]:
-        """Newest-first summaries (shared by the RPC, HTTP and dashboard
-        surfaces so they can't drift apart)."""
-        out = [self._trace_summary(e)
-               for e in reversed(list(self.traces.values()))]
-        return out[:max(0, limit)]
-
-    def _trace_detail(self, trace_id: str) -> Optional[Dict[str, Any]]:
-        """Summary + start-sorted spans for one trace, or None."""
-        ent = self.traces.get(trace_id)
-        if ent is None:
-            return None
-        trace = self._trace_summary(ent)
-        trace["spans"] = sorted(ent["spans"],
-                                key=lambda s: s.get("start", 0.0))
-        return trace
-
     async def rpc_list_traces(self, limit: int = 100):
-        return {"traces": self._trace_summaries(limit),
-                "spans_dropped": self._trace_spans_dropped}
+        store = self._ev_plane.trace_store
+        return {"traces": store.summaries(limit),
+                "spans_dropped": store.spans_dropped}
 
     async def rpc_get_trace(self, trace_id: str):
-        trace = self._trace_detail(trace_id)
+        trace = self._ev_plane.trace_store.detail(trace_id)
         if trace is None:
             return {"found": False}
         return {"found": True, "trace": trace}
 
-    def _render_traces_json(self):
+    async def _render_traces_json(self):
         import json as _json
 
+        traces = await self.shards.task_events.run_sync(
+            lambda: self._ev_plane.trace_store.summaries(100))
         return "application/json", _json.dumps(
-            self._trace_summaries(100), default=str).encode()
+            traces, default=str).encode()
 
-    def _render_one_trace_json(self, trace_id: str = ""):
+    async def _render_one_trace_json(self, trace_id: str = ""):
         import json as _json
 
-        trace = self._trace_detail(trace_id.strip("/"))
+        tid = trace_id.strip("/")
+        trace = await self.shards.task_events.run_sync(
+            lambda: self._ev_plane.trace_store.detail(tid))
         if trace is None:
             body = _json.dumps({"error": f"no trace {trace_id!r}"})
             return "application/json", body.encode()
@@ -2432,34 +2415,19 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
     # ring behind /api/timeseries (reference roles: `ray stack`,
     # profile_manager.py, and the dashboard's node-stats timeline) ---------
 
-    def _ts_record(self, node: str, name: str, value: float,
-                   ts: Optional[float] = None) -> None:
-        key = (node, name)
-        dq = self._tseries.get(key)
-        if dq is None:
-            from collections import deque as _deque
-
-            dq = self._tseries[key] = _deque(
-                maxlen=int(config.timeseries_max_samples))
-        try:
-            dq.append((ts if ts is not None else time.time(), float(value)))
-        except (TypeError, ValueError):
-            pass
-
-    def _timeseries_payload(self) -> Dict[str, Any]:
-        return {"series": [
-            {"node": node, "name": name,
-             "points": [[round(ts, 3), v] for ts, v in dq]}
-            for (node, name), dq in sorted(self._tseries.items())]}
+    # (the time-series ring lives on the telemetry plane — see
+    # head_shards.TelemetryPlane.ts_record/ts_tail/timeseries_payload;
+    # rpc_timeseries is routed to that plane's loop)
 
     async def rpc_timeseries(self):
-        return self._timeseries_payload()
+        return self._telem.timeseries_payload()
 
     def _render_timeseries_json(self):
         import json as _json
 
+        # the ring is internally locked: safe to render from this loop
         return "application/json", _json.dumps(
-            self._timeseries_payload()).encode()
+            self._telem.timeseries_payload()).encode()
 
     async def rpc_cluster_stack(self, target: str = "",
                                 timeout_s: float = 5.0):
@@ -2895,33 +2863,14 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                 "mean_ms": round(sum(s) / n * 1000, 3),
                 "max_ms": round(s[-1] * 1000, 3)}
 
-    def _cluster_summary(self) -> Dict[str, Any]:
+    async def _cluster_summary(self) -> Dict[str, Any]:
         """`rtpu summary`: per-function task aggregates (state counts +
-        queued/running percentiles off the task-event store), actor
-        counts + per-method call counts, and the per-node object-store
-        rollup from heartbeat breakdowns.  All local state — no fan-out,
-        cheap enough to poll."""
-        tasks: Dict[str, Dict[str, Any]] = {}
-        methods: Dict[str, int] = {}
-        for rec in self.task_events.values():
-            name = rec.get("name") or "?"
-            kind = rec.get("kind", NORMAL_TASK)
-            row = tasks.get(name)
-            if row is None:
-                row = tasks[name] = {"kind": kind, "states": {},
-                                     "queued_s": [], "running_s": []}
-            st = rec.get("state", "?")
-            row["states"][st] = row["states"].get(st, 0) + 1
-            sub = rec.get("submitted_ts")
-            run = rec.get("running_ts")
-            end = rec.get("finished_ts") or rec.get("failed_ts")
-            lease = rec.get("leased_ts") or run
-            if sub is not None and lease is not None:
-                row["queued_s"].append(max(0.0, lease - sub))
-            if run is not None and end is not None:
-                row["running_s"].append(max(0.0, end - run))
-            if kind == ACTOR_TASK:
-                methods[name] = methods.get(name, 0) + 1
+        queued/running percentiles, computed by the task-event plane on
+        its own loop), actor counts + per-method call counts, and the
+        per-node object-store rollup from heartbeat breakdowns.  No
+        cluster fan-out — cheap enough to poll."""
+        tasks, methods = await self.shards.task_events.run_sync(
+            self._ev_plane.summarize_tasks)
         kind_names = {NORMAL_TASK: "task", ACTOR_CREATION_TASK:
                       "actor_creation", ACTOR_TASK: "actor_method"}
         out_tasks = {
@@ -2957,7 +2906,7 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                 "ts": time.time()}
 
     async def rpc_cluster_summary(self):
-        return self._cluster_summary()
+        return await self._cluster_summary()
 
     async def _http_memory(self, query: str = ""):
         import json as _json
@@ -2966,11 +2915,11 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         out = await self._memory_view(top_n=int(p.get("top", 0) or 0))
         return "application/json", _json.dumps(out, default=str).encode()
 
-    def _render_summary_json(self):
+    async def _render_summary_json(self):
         import json as _json
 
         return "application/json", _json.dumps(
-            self._cluster_summary(), default=str).encode()
+            await self._cluster_summary(), default=str).encode()
 
     # ---- autoscaler --------------------------------------------------------
 
@@ -3028,30 +2977,6 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
             "pending_actors": pending_actors,
         }
 
-    def _sched_queued_p99_ms(self, sample: int = 500) -> float:
-        """Queued-phase (submitted->leased) p99 over the most recent
-        task events — the autoscaler's scheduler-latency SLO signal."""
-        recs = list(self.task_events.values())[-sample:]
-        waits = []
-        for rec in recs:
-            sub, leased = rec.get("submitted_ts"), rec.get("leased_ts")
-            if sub is not None and leased is not None:
-                waits.append(max(0.0, leased - sub))
-        if not waits:
-            return 0.0
-        waits.sort()
-        return round(
-            waits[min(len(waits) - 1, int(len(waits) * 0.99))] * 1000, 3)
-
-    def _ts_tail(self, metric: str, k: int = 10) -> Dict[str, List[float]]:
-        """Last k ring samples of one heartbeat metric per node — the
-        autoscaler's trend-smoothing input (PR-6 time-series ring)."""
-        out: Dict[str, List[float]] = {}
-        for (node, name), dq in self._tseries.items():
-            if name == metric and dq:
-                out[node] = [v for _ts, v in list(dq)[-k:]]
-        return out
-
     async def rpc_autoscaler_snapshot(self):
         """The v2 autoscaler input: the v1 demand/supply state plus the
         signals prior subsystems built — lease-queue-depth trends from
@@ -3061,7 +2986,13 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         bin-packing), Serve/LLM queue pressure from the heartbeat gauge
         summaries, and live drain records.  ``epoch`` is the head's
         boot token: a change tells the autoscaler to re-register its
-        node types (the DeltaReporter epoch-handshake pattern)."""
+        node types (the DeltaReporter epoch-handshake pattern).
+
+        Assembled from shard-published state: demand/supply from the
+        scheduling core's OWN tables (this loop owns them), the SLO p99
+        from the task-event plane's versioned stats snapshot, and ring
+        trends through the telemetry plane's locked ts_tail — the old
+        walk over the live task-event store from this loop is gone."""
         snap = await self.rpc_autoscaler_state()
         by_id = {n.node_id: n for n in self.nodes.values()}
         for n_out in snap["nodes"]:
@@ -3074,15 +3005,20 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
                     "num_objects": mem.get("num_objects", 0),
                 }
         snap["epoch"] = self.dir.epoch
+        ev_version, ev_stats = self._ev_plane.stats.read()
+        ts_tail = self._telem.ts_tail
         snap["signals"] = {
-            "lease_queue_depth": self._ts_tail("lease_queue_depth"),
-            "sched_queued_p99_ms": self._sched_queued_p99_ms(),
+            "lease_queue_depth": ts_tail("lease_queue_depth"),
+            "sched_queued_p99_ms": ev_stats["queued_p99_ms"],
+            "task_events_version": ev_version,
+            "tasks_finished_total": ev_stats["finished_total"],
             "serve": {
-                "llm_queue_depth": self._ts_tail("llm_queue_depth", k=5),
-                "llm_tokens_per_step": self._ts_tail("llm_tokens_per_step",
-                                                     k=5),
+                "llm_queue_depth": ts_tail("llm_queue_depth", k=5),
+                "llm_tokens_per_step": ts_tail("llm_tokens_per_step",
+                                               k=5),
             },
         }
+        snap["shards"] = self._shard_info()
         snap["drains"] = {nid: dict(rec)
                           for nid, rec in self._drains.items()}
         return snap

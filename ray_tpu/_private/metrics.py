@@ -413,17 +413,41 @@ _task_event_dropped: Optional[Counter] = None
 
 def task_events_dropped_counter() -> Counter:
     """Process-singleton ``ray_tpu_task_events_dropped_total``: task
-    state-transition records discarded because the owner-side event
-    buffer overflowed (``task_events_buffer_size``) before a flush could
-    drain it.  A nonzero rate means the observability plane is lossy —
-    raise the buffer or investigate a wedged flush; the drop itself is
-    deliberate (events must never backpressure the submit hot path)."""
+    state-transition records discarded before reaching the head's
+    store, labeled ``shard`` with WHERE the loss happened —
+    ``shard=owner`` for owner-side buffer overflow
+    (``task_events_buffer_size`` before a flush could drain it),
+    ``shard=task_events`` for head ingest-inbox overflow
+    (``head_inbox_max_frames``).  A nonzero rate means the
+    observability plane is lossy — raise the relevant bound or
+    investigate a wedged flush/shard; the drop itself is deliberate
+    (events must never backpressure the submit hot path)."""
     global _task_event_dropped
     if _task_event_dropped is None:
         _task_event_dropped = Counter(
             "ray_tpu_task_events_dropped_total",
-            "task events dropped on owner-side buffer overflow")
+            "task events dropped on buffer or ingest-inbox overflow")
     return _task_event_dropped
+
+
+_head_inbox_depth: Optional[Gauge] = None
+
+
+def head_inbox_depth_gauge() -> Gauge:
+    """Process-singleton ``ray_tpu_head_inbox_depth``: high-water mark
+    of a head ingest shard's inbound queue over the last drain window,
+    labeled ``shard`` (``task_events`` = event frames queued before the
+    per-tick merge; ``telemetry`` = heartbeat updates queued toward the
+    scheduling core).  The saturation early-warning: depth climbing
+    toward ``head_inbox_max_frames`` means drops are imminent while
+    ``ray_tpu_event_loop_lag_seconds{role=head_shard}`` shows which
+    plane is too slow."""
+    global _head_inbox_depth
+    if _head_inbox_depth is None:
+        _head_inbox_depth = Gauge(
+            "ray_tpu_head_inbox_depth",
+            "head ingest shard inbound-queue high-water mark")
+    return _head_inbox_depth
 
 
 _dispatch_batch_hist: Optional[Histogram] = None

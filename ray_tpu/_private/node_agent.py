@@ -196,6 +196,10 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         self._dir_mirror = DirectoryMirror(int(config.object_directory_shards))
         self._dir_reporter = DeltaReporter()
         self._head_dir_epoch: Optional[str] = None
+        # gauge summary the head has ACKED: heartbeats carry only the
+        # keys that changed since (None retires a vanished gauge); reset
+        # to {} to force a full re-send (head restart / need_metrics)
+        self._metrics_sent: Dict[str, float] = {}
         self._server: Optional[RpcServer] = None
         self.port = 0
         self.host = "127.0.0.1"
@@ -575,7 +579,7 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
             def _die():
                 os.kill(os.getpid(), signal.SIGKILL)
 
-            asyncio.get_event_loop().call_later(delay, _die)
+            asyncio.get_running_loop().call_later(delay, _die)
             return
         for wid, w in list(self._workers.items()):
             self._maybe_chaos_kill_worker(wid, w)
@@ -676,18 +680,36 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
                         int(config.locality_min_bytes),
                         int(config.object_directory_max_entries)),
                     self._head_dir_epoch)
+                # gauge summary as a DELTA vs what the head last acked
+                # (same version-gating idea as the directory delta): a
+                # steady-state beat re-serializes nothing
+                summary = self._metric_summary()
+                metrics_delta: Dict[str, Optional[float]] = {
+                    k: v for k, v in summary.items()
+                    if self._metrics_sent.get(k) != v}
+                for gone in self._metrics_sent.keys() - summary.keys():
+                    metrics_delta[gone] = None  # retire vanished gauge
                 reply = await self._head.call(
                     "heartbeat", node_id=self.node_id,
                     available=self.resources.available.to_dict(),
                     pending=self._pending_for_heartbeat(),
                     objects_delta=delta,
                     dir_versions=self._dir_mirror.seen_versions(),
-                    metrics=self._metric_summary(),
+                    metrics=metrics_delta or None,
                     memory=self._memory_breakdown(max_age_s=5.0),
                     pressure=self._last_pressure,
                     seen_chaos_version=self._seen_chaos_version,
                     seen_quarantine_version=self._seen_quarantine_version,
                     chaos_fired=fault_injection.fired_counts() or None)
+                if reply.get("unknown_node") or reply.get("need_metrics"):
+                    # the head restarted with no gauge cache for us (or
+                    # discarded this beat entirely): clear so the NEXT
+                    # beat re-sends the full summary — bounded one-beat
+                    # staleness, same handshake as the dir epoch reset
+                    self._metrics_sent = {}
+                else:
+                    # the head folded this delta: commit the acked state
+                    self._metrics_sent = dict(summary)
                 self._apply_chaos(reply.get("chaos"))
                 self._apply_quarantine(reply.get("quarantine"))
                 if reply.get("unknown_node"):
@@ -2324,7 +2346,7 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         orphaned = [lid for lid, lease in self._leases.items()
                     if lease.owner_conn is conn]
         if orphaned:
-            asyncio.get_event_loop().call_later(
+            asyncio.get_running_loop().call_later(
                 float(config.lease_orphan_grace_s),
                 self._reap_orphans, conn, orphaned)
 

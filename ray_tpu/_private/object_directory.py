@@ -55,6 +55,15 @@ class _Shard:
 
 
 class ShardedObjectDirectory:
+    """Head-side object location registry, sharded by oid CRC.
+
+    Thread contract: every mutation and read takes the owning shard's
+    lock (plus ``_node_lock`` for the per-node reverse index), so the
+    structure is safe to write from the head's telemetry ingest loop
+    (heartbeat delta application) while the scheduling core reads
+    ``locations()``/``updates_since()`` from its own loop — no
+    cross-loop hop needed for locality lookups."""
+
     def __init__(self, num_shards: int = 16, epoch: str = ""):
         self.num_shards = max(1, int(num_shards))
         self._shards = [_Shard() for _ in range(self.num_shards)]
@@ -140,6 +149,11 @@ class ShardedObjectDirectory:
 
     def versions(self) -> List[int]:
         return [s.version for s in self._shards]
+
+    def version_total(self) -> int:
+        """Sum of shard versions: a cheap single-number change signal
+        (monotonic while this head lives) for status surfaces."""
+        return sum(s.version for s in self._shards)
 
     def updates_since(self, seen: Optional[List[int]]
                       ) -> Dict[int, Dict[str, Any]]:

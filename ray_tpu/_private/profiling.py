@@ -258,18 +258,22 @@ def sampler_status() -> Dict[str, Any]:
 
 
 async def loop_lag_probe(role: str,
-                         on_sample: Optional[Callable[[float], None]] = None
+                         on_sample: Optional[Callable[[float], None]] = None,
+                         tags: Optional[Dict[str, str]] = None
                          ) -> None:
     """Always-on health probe for the calling event loop: sleep a fixed
     interval and measure how late the wakeup lands.  A loop wedged by a
     long callback (accidental sync IO, GIL-hogging deserialization)
     shows up here seconds before anything times out.  Exported as
-    ``ray_tpu_event_loop_lag_seconds{role=...}``; ``on_sample`` lets the
-    host also fold the value into heartbeats/time-series."""
+    ``ray_tpu_event_loop_lag_seconds{role=...}``; ``tags`` adds extra
+    labels beside role (the head's ingest shards probe their own loops
+    as ``{role=head_shard,shard=...}``); ``on_sample`` lets the host
+    also fold the value into heartbeats/time-series."""
     from ray_tpu._private.config import config
     from ray_tpu._private.metrics import loop_lag_gauge
 
     gauge = loop_lag_gauge()
+    gauge_tags = {"role": role, **(tags or {})}
     interval = max(0.05, config.loop_lag_probe_interval_ms / 1000.0)
     loop = asyncio.get_running_loop()
     while True:
@@ -277,7 +281,7 @@ async def loop_lag_probe(role: str,
         await asyncio.sleep(interval)
         lag = max(0.0, loop.time() - t0 - interval)
         try:
-            gauge.set(lag, tags={"role": role})
+            gauge.set(lag, tags=gauge_tags)
             if on_sample is not None:
                 on_sample(lag)
         except Exception:

@@ -82,7 +82,16 @@ async def _read_frame(reader: asyncio.StreamReader):
 
 
 class RpcHost:
-    """Base for RPC-serving daemons. Handlers: ``async def rpc_<name>``."""
+    """Base for RPC-serving daemons. Handlers: ``async def rpc_<name>``.
+
+    A host may expose ``rpc_op_loops`` — a ``{method: event_loop}`` map —
+    to route specific ops onto OTHER event loops: the server's read loop
+    dispatches a routed frame straight onto the owning loop (no hop
+    through the serving loop's task queue) and marshals the reply bytes
+    back.  This is how the sharded head (head_shards.py) keeps task-event
+    and heartbeat ingest off its scheduling loop."""
+
+    rpc_op_loops: Dict[str, asyncio.AbstractEventLoop] = {}
 
     async def dispatch(self, method: str, payload: Dict[str, Any]) -> Any:
         handler = getattr(self, f"rpc_{method}", None)
@@ -113,6 +122,10 @@ class RpcServer:
         self._server: Optional[asyncio.base_events.Server] = None
         self.connections: set[RpcServerConnection] = set()
         self._wants_conn_cache: Dict[str, bool] = {}
+        # concurrent.futures for handlers routed to foreign loops: a
+        # run_coroutine_threadsafe future nothing references can be
+        # GC'd mid-flight — retain until done
+        self._routed_inflight: set = set()
 
     @property
     def port(self) -> int:
@@ -162,11 +175,31 @@ class RpcServer:
                         if chaos.action == "delay":
                             await fault_injection.sleep_async(chaos.delay_s)
                 if kind == _ONEWAY:
-                    asyncio.ensure_future(self._run_oneway(conn, method, payload))
+                    target = self._route_loop(method)
+                    if target is not None:
+                        self._spawn_routed(
+                            self._run_oneway(conn, method, payload), target)
+                    else:
+                        asyncio.ensure_future(
+                            self._run_oneway(conn, method, payload))
                 elif kind == _REQUEST:
-                    asyncio.ensure_future(
-                        self._run_request(conn, writer, req_id, method, payload)
-                    )
+                    # per-op loop routing: a frame for a shard-owned op
+                    # dispatches onto the owning loop straight from the
+                    # read loop; the reply marshals back to THIS loop,
+                    # which owns the StreamWriter (see _run_request)
+                    target = self._route_loop(method)
+                    if target is not None:
+                        self._spawn_routed(
+                            self._run_request(conn, writer, req_id, method,
+                                              payload,
+                                              origin_loop=
+                                              asyncio.get_running_loop()),
+                            target)
+                    else:
+                        asyncio.ensure_future(
+                            self._run_request(conn, writer, req_id, method,
+                                              payload)
+                        )
         finally:
             self.connections.discard(conn)
             try:
@@ -189,7 +222,29 @@ class RpcServer:
 
             traceback.print_exc()
 
-    async def _run_request(self, conn, writer, req_id, method, payload):
+    def _route_loop(self, method: str):
+        """The foreign loop that owns this op, or None for the serving
+        loop (the empty default map costs one attribute read + ``get``)."""
+        op_loops = self._host_obj.rpc_op_loops
+        if not op_loops:
+            return None
+        target = op_loops.get(method)
+        if target is None:
+            return None
+        try:
+            if target is asyncio.get_running_loop():
+                return None
+        except RuntimeError:
+            pass
+        return target
+
+    def _spawn_routed(self, coro, target_loop) -> None:
+        fut = asyncio.run_coroutine_threadsafe(coro, target_loop)
+        self._routed_inflight.add(fut)
+        fut.add_done_callback(self._routed_inflight.discard)
+
+    async def _run_request(self, conn, writer, req_id, method, payload,
+                           origin_loop=None):
         try:
             payload = dict(payload or {})
             if self._wants_conn(method):
@@ -200,11 +255,31 @@ class RpcServer:
             import traceback
 
             out = _pack(_ERROR, req_id, method, f"{e}\n{traceback.format_exc()}")
+        if origin_loop is not None \
+                and origin_loop is not asyncio.get_running_loop():
+            # routed handler: the StreamWriter belongs to the serving
+            # loop — marshal the reply bytes back and write/drain there
+            try:
+                origin_loop.call_soon_threadsafe(
+                    self._write_from_origin, writer, out)
+            except RuntimeError:
+                pass  # serving loop closed mid-flight
+            return
         try:
             writer.write(out)
             await writer.drain()
         except (ConnectionResetError, RuntimeError):
             pass
+
+    def _write_from_origin(self, writer, out: bytes) -> None:
+        async def _w():
+            try:
+                writer.write(out)
+                await writer.drain()
+            except (ConnectionResetError, RuntimeError):
+                pass
+
+        asyncio.ensure_future(_w())
 
     def _wants_conn(self, method: str) -> bool:
         cached = self._wants_conn_cache.get(method)

@@ -323,6 +323,79 @@ def stats() -> Dict[str, int]:
         return dict(_counts, buffered=len(_buffer))
 
 
+# ------------------------------------------------- head-side store
+
+
+class TraceStore:
+    """The head's bounded trace store: trace_id -> {spans, start, end,
+    root}, insertion-ordered so the oldest traces fall off at the cap
+    (the task-event store pattern applied to spans).
+
+    Owned by the head's task-event ingest plane (head_shards.py): every
+    method runs on that plane's loop, and cross-loop readers (dashboard
+    HTTP, CLI RPCs) reach it via the plane's run_sync routing — the
+    store itself needs no lock."""
+
+    def __init__(self, max_traces: int, max_spans: int):
+        self.traces: Dict[str, Dict[str, Any]] = {}
+        self.max_traces = int(max_traces)
+        self.max_spans = int(max_spans)
+        self.spans_dropped = 0
+
+    def ingest(self, spans: List[Dict[str, Any]]) -> None:
+        for s in spans:
+            trace_id = s.get("trace_id")
+            if not trace_id:
+                continue
+            ent = self.traces.get(trace_id)
+            if ent is None:
+                while len(self.traces) >= self.max_traces:
+                    self.traces.pop(next(iter(self.traces)))
+                ent = self.traces[trace_id] = {
+                    "trace_id": trace_id, "spans": [],
+                    "start": s.get("start", 0.0), "end": 0.0, "root": "",
+                }
+            if len(ent["spans"]) >= self.max_spans:
+                self.spans_dropped += 1
+                continue
+            ent["spans"].append(s)
+            start = s.get("start") or 0.0
+            if start and (not ent["start"] or start < ent["start"]):
+                ent["start"] = start
+            ent["end"] = max(ent["end"], s.get("end") or 0.0)
+            if not s.get("parent_id"):
+                ent["root"] = s.get("name", "")
+
+    @staticmethod
+    def _summary(ent: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            "trace_id": ent["trace_id"],
+            "num_spans": len(ent["spans"]),
+            "root": ent.get("root", ""),
+            "start": ent.get("start", 0.0),
+            "end": ent.get("end", 0.0),
+            "duration_s": max(0.0, (ent.get("end") or 0.0)
+                              - (ent.get("start") or 0.0)),
+        }
+
+    def summaries(self, limit: int) -> List[Dict[str, Any]]:
+        """Newest-first summaries (shared by the RPC, HTTP and dashboard
+        surfaces so they can't drift apart)."""
+        out = [self._summary(e)
+               for e in reversed(list(self.traces.values()))]
+        return out[:max(0, limit)]
+
+    def detail(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        """Summary + start-sorted spans for one trace, or None."""
+        ent = self.traces.get(trace_id)
+        if ent is None:
+            return None
+        trace = self._summary(ent)
+        trace["spans"] = sorted(ent["spans"],
+                                key=lambda s: s.get("start", 0.0))
+        return trace
+
+
 # ------------------------------------------------- W3C trace-context
 
 

@@ -647,7 +647,7 @@ class CoreWorker(IntrospectionRpcMixin, RpcHost):
                     task_events_dropped_counter
 
                 self._ev_dropped_counter = task_events_dropped_counter()
-            self._ev_dropped_counter.inc(dropped)
+            self._ev_dropped_counter.inc(dropped, tags={"shard": "owner"})
         if schedule:
             # completion events flush on a short coalescing delay instead
             # of waiting out the periodic interval: a snapshot taken right

@@ -140,7 +140,30 @@ def _print_status(addr, head) -> None:
         print(f"pending demands: {pending} lease(s), "
               f"{len(auto['pending_pg_bundles'])} pg bundle(s), "
               f"{len(auto['pending_actors'])} actor(s)")
+    _print_shards(head)
     _print_autoscaler(head)
+
+
+def _print_shards(head) -> None:
+    """Head ingest shard pane: which planes run on their own loop and
+    how laggy each loop is — the first place to look when the head
+    feels slow (count 0 = single-loop compat mode)."""
+    try:
+        snap = head.call("autoscaler_snapshot", timeout=10)
+    except Exception:
+        return
+    sh = snap.get("shards") or {}
+    planes = sh.get("planes") or {}
+    if not planes:
+        return
+    parts = []
+    for name, p in sorted(planes.items()):
+        where = "own loop" if p.get("own_thread") else "head loop"
+        part = f"{name}={where} lag {p.get('lag_s', 0) * 1000:.1f}ms"
+        if p.get("dropped"):
+            part += f" dropped {p['dropped']}"
+        parts.append(part)
+    print(f"head ingest shards: {sh.get('count', 0)}  " + "  ".join(parts))
 
 
 def _print_autoscaler(head) -> None:

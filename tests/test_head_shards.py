@@ -1,0 +1,362 @@
+"""Head control-plane sharding tests (_private/head_shards.py + the
+rpc.py per-op loop routing it rides on).
+
+Units: op -> owning-loop dispatch, cross-shard queue drain batching,
+versioned-snapshot monotonicity across a simulated head restart.
+E2e: a 10k-task burst through a sharded head (``head_ingest_shards=2``)
+completes flat with zero dropped task events, and the single-loop
+compat mode (``head_ingest_shards=0``) runs the same surface.
+"""
+
+import asyncio
+import os
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private import rpc as rpcmod
+from ray_tpu._private.head_shards import (CrossShardQueue, HeadShards,
+                                          VersionedSnapshot)
+
+
+# --------------------------------------------------------- VersionedSnapshot
+
+
+def test_versioned_snapshot_read_is_consistent_pair():
+    s = VersionedSnapshot(payload={"a": 1})
+    v0, p0 = s.read()
+    assert p0 == {"a": 1}
+    v1 = s.publish({"a": 2})
+    assert v1 == v0 + 1
+    ver, payload = s.read()
+    assert (ver, payload) == (v1, {"a": 2})
+    assert s.version == v1 and s.payload == {"a": 2}
+
+
+def test_versioned_snapshot_monotonic_across_restart():
+    """A restarted publisher (head restart rebuilding its snapshots)
+    must seed ABOVE anything the old incarnation published, so 'only
+    apply newer' guards downstream stay correct across the boundary."""
+    old = VersionedSnapshot(payload=None)
+    last = 0
+    for i in range(50):
+        last = old.publish({"i": i})
+    time.sleep(0.001)  # the old head dies; a new one comes up
+    fresh = VersionedSnapshot(payload=None)
+    assert fresh.version > last
+    assert fresh.publish({"rebuilt": True}) > last
+
+
+def test_versioned_snapshot_explicit_seed():
+    s = VersionedSnapshot(payload=None, start_version=7)
+    assert s.version == 7
+    assert s.publish("x") == 8
+
+
+# ----------------------------------------------------------- CrossShardQueue
+
+
+def test_cross_shard_queue_drains_backlog_in_one_callback():
+    """N producer puts must cost the consumer loop far fewer than N
+    callbacks: the drain sweeps the whole backlog per scheduled tick."""
+    io = rpcmod.EventLoopThread(name="test-core")
+    got = []
+    drains = []
+
+    def _drain(items):
+        drains.append(len(items))
+        got.extend(items)
+
+    q = CrossShardQueue(io.loop, _drain, name="test")
+    try:
+        # stall the consumer loop so puts pile up behind one callback
+        async def _stall():
+            time.sleep(0.15)
+
+        fut = asyncio.run_coroutine_threadsafe(_stall(), io.loop)
+        n = 500
+        for i in range(n):
+            q.put(i)
+        fut.result(timeout=5)
+        deadline = time.monotonic() + 5
+        while len(got) < n and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sorted(got) == list(range(n))
+        assert len(drains) < n / 10, (
+            f"{len(drains)} callbacks for {n} puts — batching broken")
+        assert q.take_high_water() >= 1
+        assert q.take_high_water() == 0  # reset after take
+    finally:
+        io.stop()
+
+
+def test_cross_shard_queue_survives_drain_exception():
+    io = rpcmod.EventLoopThread(name="test-core2")
+    seen = []
+
+    def _drain(items):
+        seen.extend(items)
+        if items[0] == "boom":
+            raise RuntimeError("drain_cb blew up")
+
+    q = CrossShardQueue(io.loop, _drain, name="test")
+    try:
+        q.put("boom")
+        deadline = time.monotonic() + 5
+        while "boom" not in seen and time.monotonic() < deadline:
+            time.sleep(0.01)
+        q.put("after")  # the queue must keep working after a cb error
+        while "after" not in seen and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert seen == ["boom", "after"]
+    finally:
+        io.stop()
+
+
+# -------------------------------------------------------- per-op loop routing
+
+
+class _RoutedHost(rpcmod.RpcHost):
+    """Records which loop each handler ran on."""
+
+    def __init__(self, shard_loop):
+        self.rpc_op_loops = {"shard_op": shard_loop, "shard_note": shard_loop}
+        self.notes = []
+
+    async def rpc_shard_op(self, x=0):
+        return {"x": x, "loop": id(asyncio.get_running_loop()),
+                "thread": threading.get_ident()}
+
+    async def rpc_main_op(self, x=0):
+        return {"x": x, "loop": id(asyncio.get_running_loop()),
+                "thread": threading.get_ident()}
+
+    async def rpc_shard_note(self, x=0):
+        self.notes.append((x, id(asyncio.get_running_loop())))
+
+
+def test_routed_op_dispatches_on_owning_loop():
+    """A frame for a shard-owned op must run its handler on the owning
+    shard's loop (and still reply correctly over the serving loop's
+    writer); unrouted ops stay on the serving loop."""
+    serve = rpcmod.EventLoopThread(name="test-serve")
+    shard = rpcmod.EventLoopThread(name="test-shard")
+    cli_io = rpcmod.EventLoopThread(name="test-cli")
+    host = _RoutedHost(shard.loop)
+    server = rpcmod.RpcServer(host)
+    client = None
+    try:
+        port = serve.run(server.start(), timeout=10)
+        client = rpcmod.SyncRpcClient("127.0.0.1", port, cli_io)
+        routed = client.call("shard_op", x=1, timeout=10)
+        plain = client.call("main_op", x=2, timeout=10)
+        assert routed["x"] == 1 and plain["x"] == 2
+        assert routed["loop"] == id(shard.loop)
+        assert plain["loop"] == id(serve.loop)
+        assert routed["thread"] != plain["thread"]
+
+        # oneway frames route too (the task-event ingest path)
+        for i in range(5):
+            client.oneway("shard_note", x=i)
+        deadline = time.monotonic() + 5
+        while len(host.notes) < 5 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert [x for x, _ in host.notes] == [0, 1, 2, 3, 4]
+        assert all(lp == id(shard.loop) for _, lp in host.notes)
+    finally:
+        if client is not None:
+            client.close()
+        try:
+            serve.run(server.stop(), timeout=10)
+        except Exception:
+            pass
+        for elt in (serve, shard, cli_io):
+            elt.stop()
+
+
+def test_route_map_empty_means_serving_loop():
+    serve = rpcmod.EventLoopThread(name="test-serve2")
+    cli_io = rpcmod.EventLoopThread(name="test-cli2")
+
+    class _Plain(rpcmod.RpcHost):
+        async def rpc_echo(self, x=0):
+            return {"x": x, "loop": id(asyncio.get_running_loop())}
+
+    server = rpcmod.RpcServer(_Plain())
+    client = None
+    try:
+        port = serve.run(server.start(), timeout=10)
+        client = rpcmod.SyncRpcClient("127.0.0.1", port, cli_io)
+        out = client.call("echo", x=9, timeout=10)
+        assert out == {"x": 9, "loop": id(serve.loop)}
+    finally:
+        if client is not None:
+            client.close()
+        try:
+            serve.run(server.stop(), timeout=10)
+        except Exception:
+            pass
+        serve.stop()
+        cli_io.stop()
+
+
+# -------------------------------------------------------- HeadShards topology
+
+
+def test_head_shards_topology_by_count():
+    head_loop = asyncio.new_event_loop()
+    try:
+        compat = HeadShards(0, head_loop)
+        assert not compat.sharded
+        assert compat.task_events.loop is head_loop
+        assert compat.telemetry.loop is head_loop
+        assert not compat.task_events.own_thread
+        assert compat.op_loops() == {}
+        compat.stop()  # must not close the head loop it wrapped
+        assert not head_loop.is_closed()
+
+        shared = HeadShards(1, head_loop)
+        try:
+            assert shared.sharded
+            assert shared.task_events.loop is shared.telemetry.loop
+            assert shared.task_events.loop is not head_loop
+            ops = shared.op_loops()
+            assert ops["task_events"] is ops["heartbeat"]
+        finally:
+            shared.stop()
+
+        two = HeadShards(2, head_loop)
+        try:
+            assert two.task_events.loop is not two.telemetry.loop
+            ops = two.op_loops()
+            assert ops["task_events"] is two.task_events.loop
+            assert ops["trace_spans"] is two.task_events.loop
+            assert ops["list_tasks"] is two.task_events.loop
+            assert ops["heartbeat"] is two.telemetry.loop
+            assert ops["timeseries"] is two.telemetry.loop
+        finally:
+            two.stop()
+    finally:
+        head_loop.close()
+
+
+def test_run_sync_inline_and_cross_loop():
+    shards = HeadShards(2, asyncio.new_event_loop())
+    drv = rpcmod.EventLoopThread(name="test-drv")
+    try:
+        async def _from_foreign_loop():
+            return await shards.task_events.run_sync(
+                lambda: threading.get_ident())
+
+        tid = asyncio.run_coroutine_threadsafe(
+            _from_foreign_loop(), drv.loop).result(timeout=10)
+        on_shard = asyncio.run_coroutine_threadsafe(
+            shards.task_events.run_sync(lambda: threading.get_ident()),
+            shards.task_events.loop).result(timeout=10)
+        assert tid == on_shard  # both executed on the shard thread
+        assert tid != threading.get_ident()
+    finally:
+        shards.stop()
+        drv.stop()
+
+
+# ------------------------------------------------------------------- e2e
+
+
+def _head():
+    import ray_tpu
+
+    return ray_tpu.api._worker().head
+
+
+@pytest.fixture
+def sharded_cluster():
+    import ray_tpu
+
+    os.environ["RT_HEAD_INGEST_SHARDS"] = "2"
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    try:
+        yield ray_tpu
+    finally:
+        ray_tpu.shutdown()
+        os.environ.pop("RT_HEAD_INGEST_SHARDS", None)
+
+
+@pytest.fixture
+def single_loop_cluster():
+    import ray_tpu
+
+    os.environ["RT_HEAD_INGEST_SHARDS"] = "0"
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    try:
+        yield ray_tpu
+    finally:
+        ray_tpu.shutdown()
+        os.environ.pop("RT_HEAD_INGEST_SHARDS", None)
+
+
+def test_sharded_head_admits_10k_task_burst(sharded_cluster):
+    """The acceptance e2e: 10k tasks through a 2-shard head complete
+    flat, the head reports the sharded topology, and ZERO task events
+    were dropped on the ingest inbox."""
+    ray_tpu = sharded_cluster
+
+    @ray_tpu.remote
+    def unit(i):
+        return i
+
+    n = 10_000
+    refs = [unit.remote(i) for i in range(n)]
+    out = ray_tpu.get(refs, timeout=300)
+    assert out == list(range(n))
+
+    snap = _head().call("autoscaler_snapshot", timeout=30)
+    sh = snap["shards"]
+    assert sh["count"] == 2
+    assert sh["planes"]["task_events"]["own_thread"]
+    assert sh["planes"]["telemetry"]["own_thread"]
+    assert sh["planes"]["task_events"]["dropped"] == 0
+
+    # the event store saw the burst: every task reached a terminal
+    # state (finished_total is monotonic — cap-trimming old records
+    # must not deflate it)
+    deadline = time.monotonic() + 30
+    fin = 0
+    while time.monotonic() < deadline:
+        snap = _head().call("autoscaler_snapshot", timeout=30)
+        fin = snap["signals"]["tasks_finished_total"]
+        if fin >= n:
+            break
+        time.sleep(0.25)
+    assert fin >= n
+    assert snap["signals"]["task_events_version"] > 0
+
+    # routed read path: list_tasks serves off the task-event shard
+    tasks = _head().call("list_tasks", state="FINISHED", limit=10,
+                         timeout=30)
+    assert tasks
+
+
+def test_single_loop_compat_mode(single_loop_cluster):
+    """head_ingest_shards=0: same planes, same rpc surface, no extra
+    threads — the upgrade-safety escape hatch."""
+    ray_tpu = single_loop_cluster
+
+    @ray_tpu.remote
+    def unit(i):
+        return i * 2
+
+    n = 300
+    out = ray_tpu.get([unit.remote(i) for i in range(n)], timeout=120)
+    assert out == [i * 2 for i in range(n)]
+
+    snap = _head().call("autoscaler_snapshot", timeout=30)
+    sh = snap["shards"]
+    assert sh["count"] == 0
+    assert not sh["planes"]["task_events"]["own_thread"]
+    assert sh["planes"]["task_events"]["dropped"] == 0
+    assert _head().call("list_tasks", limit=5, timeout=30)
+    # heartbeat-fed surfaces still flow on the single loop
+    ts = _head().call("timeseries", timeout=30)
+    assert isinstance(ts.get("series"), list)

@@ -347,7 +347,8 @@ def test_metric_names_documented_in_readme(cluster):
                m.deadline_metrics,
                m.serve_tail_metrics,
                m.memory_pressure_metrics,
-               m.object_checksum_failures_counter):
+               m.object_checksum_failures_counter,
+               m.head_inbox_depth_gauge):
         fn()
     with m.default_registry._lock:
         names |= set(m.default_registry._metrics)
