@@ -847,6 +847,68 @@ def bench_llm_serve(ray_tpu, pairs=2, streams=64, big_streams=256):
             npx["pages_allocated_total"] / px["pages_allocated_total"], 2)
         out["llm_prefix_ttft_p99_vs_nosharing_x"] = round(
             min(npx_ttft) / min(px_ttft), 2)
+
+        # ---- paged decode A/B (ISSUE 19): decode-step cost vs context.
+        # The Pallas paged kernel walks USED pages only, so (a) growing
+        # a config's max_seq_len 4x leaves short-context step cost
+        # ~flat, while the dense reference gathers + softmaxes the full
+        # [B, max_seq] context every step; (b) within one config, paged
+        # step cost follows the sequence's actual context length.
+        # In-process engines (no transport), alternating pairs,
+        # best-of; ratios only per the sandbox protocol — the driver
+        # box is authoritative for absolute step times.
+        ab_model = {"vocab_size": 128, "dim": 128, "n_layers": 2,
+                    "n_heads": 8, "n_kv_heads": 4, "hidden_dim": 256}
+
+        def mk_eng(impl, max_seq):
+            pps = -(-max_seq // 16)
+            return LLMEngine(model=dict(ab_model, max_seq_len=max_seq),
+                             page_size=16, prefill_chunk=32, seed=7,
+                             num_pages=1 + 8 * pps, max_batch=8,
+                             prefill_lanes=8, max_queue=64,
+                             attention_impl=impl)
+
+        def step_cost(eng, prompt_len, new_toks, tag):
+            p = [((i * 13) % 120) + 1 for i in range(prompt_len)]
+            reqs = [{"tokens": p, "max_new_tokens": new_toks,
+                     "request_id": f"{tag}-{i}"} for i in range(8)]
+            s0 = eng.stats()
+            eng.generate_batch(reqs)
+            s1 = eng.stats()
+            steps = s1["decode_steps"] - s0["decode_steps"]
+            return (s1["decode_secs"] - s0["decode_secs"]) / max(steps, 1)
+
+        grid = [(impl, ms) for impl in ("paged", "dense")
+                for ms in (128, 512)]
+        engines = {key: mk_eng(*key) for key in grid}
+        for key, eng in engines.items():
+            step_cost(eng, 16, 4, f"ab-warm-{key[0]}-{key[1]}")
+        cost = {key: min(step_cost(engines[key], 16, 32,
+                                   f"ab{r}-{key[0]}-{key[1]}")
+                         for r in range(pairs))
+                for key in grid}
+        pg = cost[("paged", 512)] / cost[("paged", 128)]
+        dg = cost[("dense", 512)] / cost[("dense", 128)]
+        # max context grew 4x: paged should be ~1x (sub-linear), dense
+        # heads toward 4x (linear in max context)
+        out["llm_decode_maxctx_growth_paged_x"] = round(pg, 2)
+        out["llm_decode_maxctx_growth_dense_x"] = round(dg, 2)
+        out["llm_decode_paged_vs_dense_growth_x"] = round(dg / pg, 2)
+        # step-latency-vs-USED-context curve at max_seq_len=512, each
+        # impl normalized to its own shortest-context point: paged
+        # follows used pages, dense sits at full-context cost from the
+        # first token
+        curve = {}
+        for impl in ("paged", "dense"):
+            pts = {plen: min(step_cost(engines[(impl, 512)], plen, 8,
+                                       f"cv{r}-{impl}-{plen}")
+                             for r in range(pairs))
+                   for plen in (16, 64, 160, 320)}
+            base = pts[16]
+            curve[impl] = {str(k): round(v / base, 2)
+                           for k, v in pts.items()}
+        out["llm_decode_step_vs_ctx_paged_x"] = curve["paged"]
+        out["llm_decode_step_vs_ctx_dense_x"] = curve["dense"]
     finally:
         try:
             serve.shutdown_http()

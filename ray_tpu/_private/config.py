@@ -216,6 +216,10 @@ _def("llm_prefix_sharing", True)    # copy-on-write prefix sharing: admit
 # sequences whose page-aligned prompt prefix matches a live sequence's
 # onto the SAME physical KV pages (refcounted; recycled at refcount 0),
 # prefilling only from the first unshared token
+_def("llm_attention_impl", "auto")  # decode attention: "paged" = Pallas
+# paged-attention kernel over block tables (cost tracks USED context),
+# "dense" = gather-then-dense reference (cost tracks max context),
+# "auto" = paged
 _def("llm_disagg_min_prompt", 0)    # disaggregated prefill: prompts at
 # least this long route their prefill to the dedicated prefill pool
 # (when llm_deployment(prefill_replicas=N) created one); shorter

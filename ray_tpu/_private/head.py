@@ -382,6 +382,11 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
         self._driver_clients.clear()
         if self._metrics_server is not None:
             self._metrics_server.close()
+        if getattr(self, "_metrics_collector", None) is not None:
+            from ray_tpu._private.metrics import default_registry
+
+            default_registry.remove_collector(self._metrics_collector)
+            self._metrics_collector = None
         if self._server:
             await self._server.stop()
         if self.shards is not None:
@@ -2152,6 +2157,10 @@ class HeadService(IntrospectionRpcMixin, RpcHost):
             tasks_g.set(ev["num_events"])
             traces_g.set(ev["num_traces"])
 
+        # keep the handle so stop() can deregister: the closure pins the
+        # whole head in the process-lifetime registry otherwise (the
+        # in-process test harnesses would leak every head ever started)
+        self._metrics_collector = collect
         default_registry.add_collector(collect)
         try:
             from ray_tpu._private import dashboard as _dash

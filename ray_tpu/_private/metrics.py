@@ -736,10 +736,11 @@ def serve_request_latency_histogram() -> Histogram:
 
 
 _llm_metrics: Optional[Tuple[Counter, Gauge, Gauge, Histogram,
-                             Gauge, Gauge]] = None
+                             Gauge, Gauge, Histogram]] = None
 
 
-def llm_metrics() -> Tuple[Counter, Gauge, Gauge, Histogram, Gauge, Gauge]:
+def llm_metrics() -> Tuple[Counter, Gauge, Gauge, Histogram, Gauge, Gauge,
+                           Histogram]:
     """Process-singleton LLM serving-tier metrics (serve/llm.py, set by
     the replica engine each decode step):
     ``ray_tpu_llm_tokens_total`` — tokens processed, labeled
@@ -751,7 +752,10 @@ def llm_metrics() -> Tuple[Counter, Gauge, Gauge, Histogram, Gauge, Gauge]:
     (admission queueing + chunked prefill, the serving SLO histogram);
     ``ray_tpu_llm_queue_depth`` — sequences waiting in the admission
     queue; ``ray_tpu_llm_tokens_per_step`` — tokens the last engine
-    step processed (prefill chunk + decode lanes).  The queue/step
+    step processed (prefill chunk + decode lanes);
+    ``ray_tpu_llm_decode_step_seconds`` — wall time of one decode
+    forward over the batch (the paged-attention kernel's target: step
+    time should track USED context, not max context).  The queue/step
     gauges also ride the agent heartbeat into the head time-series ring
     (``rtpu status --watch`` serving-pressure pane)."""
     global _llm_metrics
@@ -771,6 +775,10 @@ def llm_metrics() -> Tuple[Counter, Gauge, Gauge, Histogram, Gauge, Gauge]:
                   "sequences waiting in the LLM admission queue"),
             Gauge("ray_tpu_llm_tokens_per_step",
                   "tokens processed by the last LLM engine step"),
+            Histogram("ray_tpu_llm_decode_step_seconds",
+                      "wall time of one batched LLM decode forward",
+                      boundaries=[0.0005, 0.001, 0.0025, 0.005, 0.01,
+                                  0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5]),
         )
     return _llm_metrics
 

@@ -347,6 +347,10 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
             leases_g.set(len(self._leases))
             queued_g.set(len(self._lease_waiters))
 
+        # keep the handle: the registry is a process-lifetime singleton,
+        # and the closure captures the whole agent — stop() must remove
+        # it or every in-process agent (tests) stays pinned forever
+        self._metrics_collector = collect
         default_registry.add_collector(collect)
         try:
             self._metrics_server, self.metrics_port = \
@@ -460,6 +464,11 @@ class NodeAgent(IntrospectionRpcMixin, RpcHost):
         await self._xfer.stop()
         if getattr(self, "_metrics_server", None) is not None:
             self._metrics_server.close()
+        if getattr(self, "_metrics_collector", None) is not None:
+            from ray_tpu._private.metrics import default_registry
+
+            default_registry.remove_collector(self._metrics_collector)
+            self._metrics_collector = None
         if self._server:
             await self._server.stop()
         self.store.close(unlink=True)

@@ -225,7 +225,9 @@ class RpcServer:
     def _route_loop(self, method: str):
         """The foreign loop that owns this op, or None for the serving
         loop (the empty default map costs one attribute read + ``get``)."""
-        op_loops = self._host_obj.rpc_op_loops
+        # duck-typed hosts (e.g. the serve rpc ingress) may not carry
+        # the RpcHost class attribute at all
+        op_loops = getattr(self._host_obj, "rpc_op_loops", None)
         if not op_loops:
             return None
         target = op_loops.get(method)

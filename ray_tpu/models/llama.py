@@ -21,10 +21,13 @@ modules accept an optional paged KV-cache pytree (``make_kv_cache`` /
 sees flat per-layer slot pools plus precomputed write-slot and
 context-gather index arrays; the serving engine owns the block tables
 that map sequence positions to physical page slots.  New keys/values
-are written post-rope at their absolute positions, context is gathered
-dense per sequence, and causality is enforced with a position mask
-(``ctx_pos <= q_pos``), so chunked prefill and single-token decode ride
-one code path with static shapes.
+are written post-rope at their absolute positions.  Chunked prefill
+gathers context dense per sequence with a position mask
+(``ctx_pos <= q_pos``) for causality; single-token decode can instead
+carry page-granular block tables + context lengths and route through
+the Pallas paged-attention kernel (ray_tpu/ops/paged_attention.py),
+which reads used pages only — no dense gather.  Both ride static
+shapes.
 """
 
 from __future__ import annotations
@@ -105,11 +108,33 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+# Self-attention prefills at or above this length route through the
+# Pallas flash kernel instead of materializing the [S, S] score matrix.
+# Module-level so tests/benches can lower it; sequences must also be a
+# multiple of the flash block (128) to qualify.
+FLASH_PREFILL_MIN_SEQ = 512
+
+
 def default_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       causal: bool = True) -> jax.Array:
     """Reference attention path: XLA fuses this well on its own; the
     Pallas flash kernel (ray_tpu/ops/flash_attention.py) replaces it for
-    long sequences. q: [B,S,H,D], k/v: [B,S,Hkv,D]."""
+    long sequences (>= FLASH_PREFILL_MIN_SEQ, multiple of 128).
+    q: [B,S,H,D], k/v: [B,S,Hkv,D]."""
+    s, t = q.shape[1], k.shape[1]
+    if (causal and s == t and s >= FLASH_PREFILL_MIN_SEQ
+            and s % 128 == 0):
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, True)
+    return dense_attention(q, k, v, causal)
+
+
+def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True) -> jax.Array:
+    """The dense softmax-attention math itself — kept separate from
+    :func:`default_attention` so the flash kernel's recompute backward
+    can target it without re-entering the length-based routing."""
     b, s, h, d = q.shape
     hkv = k.shape[2]
     group = h // hkv
@@ -165,6 +190,7 @@ class RMSNorm(nn.Module):
 class Attention(nn.Module):
     cfg: LlamaConfig
     kernel: Optional[Callable] = None  # pluggable (flash/ring) attention
+    page_size: int = 0  # > 0 enables the paged decode kernel
 
     @nn.compact
     def __call__(self, x, positions, cache=None):
@@ -190,9 +216,20 @@ class Attention(nn.Module):
                 k.reshape(b * s, *k.shape[2:]))
             pool_v = cache["v"].at[flat].set(
                 v.reshape(b * s, *v.shape[2:]))
-            out = cached_attention(q, pool_k, pool_v, cache["ctx"],
-                                   cache["ctx_pos"], cache["ctx_mask"],
-                                   positions)
+            if cache.get("block_tables") is not None and s == 1 \
+                    and self.page_size > 0:
+                # decode via the Pallas paged kernel: page-granular
+                # block tables + context lengths, no dense gather
+                from ray_tpu.ops.paged_attention import paged_attention
+
+                out = paged_attention(q, pool_k, pool_v,
+                                      cache["block_tables"],
+                                      cache["context_lens"],
+                                      page_size=self.page_size)
+            else:
+                out = cached_attention(q, pool_k, pool_v, cache["ctx"],
+                                       cache["ctx_pos"],
+                                       cache["ctx_mask"], positions)
             return wo(out), pool_k, pool_v
         attend = self.kernel or default_attention
         return wo(attend(q, k, v))
@@ -214,11 +251,13 @@ class Mlp(nn.Module):
 class Block(nn.Module):
     cfg: LlamaConfig
     kernel: Optional[Callable] = None
+    page_size: int = 0
 
     @nn.compact
     def __call__(self, x, positions, cache=None):
         attn_in = RMSNorm(self.cfg.norm_eps, name="attn_norm")(x)
-        attn = Attention(self.cfg, self.kernel, name="attn")
+        attn = Attention(self.cfg, self.kernel, self.page_size,
+                         name="attn")
         if cache is not None:
             a, pool_k, pool_v = attn(attn_in, positions, cache)
             x = x + a
@@ -234,6 +273,7 @@ class Block(nn.Module):
 class LlamaModel(nn.Module):
     cfg: LlamaConfig
     kernel: Optional[Callable] = None
+    page_size: int = 0
 
     @nn.compact
     def __call__(self, tokens, cache=None):
@@ -243,15 +283,25 @@ class LlamaModel(nn.Module):
         if cache is not None:
             # incremental decode/prefill over the paged KV cache: query
             # positions come from the engine, per-layer pools are
-            # threaded through and returned updated
+            # threaded through and returned updated.  The cache carries
+            # EITHER dense gather arrays (ctx/ctx_pos/ctx_mask — chunked
+            # prefill, or dense decode) OR page-granular block tables +
+            # context lengths (paged decode kernel).
             positions = cache["q_pos"]
+            paged = cache.get("block_tables") is not None
             new_k, new_v = [], []
             for i in range(cfg.n_layers):
                 layer_cache = {"k": cache["k"][i], "v": cache["v"][i],
-                               "slots": cache["slots"], "ctx": cache["ctx"],
-                               "ctx_pos": cache["ctx_pos"],
-                               "ctx_mask": cache["ctx_mask"]}
-                x, pk, pv = Block(cfg, self.kernel, name=f"layer_{i}")(
+                               "slots": cache["slots"]}
+                if paged:
+                    layer_cache["block_tables"] = cache["block_tables"]
+                    layer_cache["context_lens"] = cache["context_lens"]
+                else:
+                    layer_cache.update(
+                        ctx=cache["ctx"], ctx_pos=cache["ctx_pos"],
+                        ctx_mask=cache["ctx_mask"])
+                x, pk, pv = Block(cfg, self.kernel, self.page_size,
+                                  name=f"layer_{i}")(
                     x, positions, layer_cache)
                 new_k.append(pk)
                 new_v.append(pv)

@@ -136,10 +136,12 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
 
 
 def _bwd(causal, block_q, block_k, interpret, res, g_out):
-    from ray_tpu.models.llama import default_attention
+    # dense_attention, not default_attention: the latter routes long
+    # sequences back into this kernel, which would recurse at trace time
+    from ray_tpu.models.llama import dense_attention
 
     q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: default_attention(q, k, v, causal=causal),
+    _, vjp = jax.vjp(lambda q, k, v: dense_attention(q, k, v, causal=causal),
                      q, k, v)
     return vjp(g_out)
 

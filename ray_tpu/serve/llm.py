@@ -106,12 +106,20 @@ def _chain_hash(parent: bytes, block) -> bytes:
 
 def _jit_forward(model, params, k, v, tokens, slots, ctx, ctx_pos,
                  ctx_mask, q_pos, last_idx, temperature=0.0, top_k=0,
-                 rng=None):
+                 rng=None, block_tables=None, context_lens=None):
     """One forward over the paged cache -> (next tokens at ``last_idx``,
     updated pools).  Jitted ONCE per (model, shapes, sampling knobs) —
     the flax module AND the sampling knobs are hashable static
     arguments, so every engine instance with the same config shares the
     compiled executable (k/v pools donated: in-place cache updates).
+
+    Context comes in one of two forms, selected by whether
+    ``block_tables`` is an array or None — a pytree-structure change,
+    so each form is its own trace: dense ``ctx``/``ctx_pos``/
+    ``ctx_mask`` gather arrays (chunked prefill, dense decode), or
+    page-granular ``block_tables`` + ``context_lens`` routing decode
+    through the Pallas paged-attention kernel (pass ctx/ctx_pos/
+    ctx_mask as None then).
 
     Sampling is a pair of jit-STATIC knobs (ISSUE 13 satellite / PR-11
     declared headroom (d)): ``temperature == 0`` compiles the exact
@@ -129,13 +137,17 @@ def _jit_forward(model, params, k, v, tokens, slots, ctx, ctx_pos,
         import jax.numpy as jnp
 
         def _fwd(model, params, k, v, tokens, slots, ctx, ctx_pos,
-                 ctx_mask, q_pos, last_idx, rng,
-                 temperature=key[0], top_k=key[1]):
+                 ctx_mask, q_pos, last_idx, rng, block_tables,
+                 context_lens, temperature=key[0], top_k=key[1]):
+            cache = {"k": k, "v": v, "slots": slots, "q_pos": q_pos}
+            if block_tables is not None:
+                cache["block_tables"] = block_tables
+                cache["context_lens"] = context_lens
+            else:
+                cache.update(ctx=ctx, ctx_pos=ctx_pos,
+                             ctx_mask=ctx_mask)
             logits, pools = model.apply(
-                {"params": params}, tokens,
-                {"k": k, "v": v, "slots": slots, "ctx": ctx,
-                 "ctx_pos": ctx_pos, "ctx_mask": ctx_mask,
-                 "q_pos": q_pos})
+                {"params": params}, tokens, cache)
             picked = jnp.take_along_axis(
                 logits, last_idx[:, None, None], axis=1)[:, 0]
             if temperature <= 0.0:
@@ -153,7 +165,7 @@ def _jit_forward(model, params, k, v, tokens, slots, ctx, ctx_pos,
 
         rng = jnp.zeros((2,), dtype="uint32")  # unused when greedy
     return fn(model, params, k, v, tokens, slots, ctx, ctx_pos, ctx_mask,
-              q_pos, last_idx, rng)
+              q_pos, last_idx, rng, block_tables, context_lens)
 
 
 class _Seq:
@@ -239,7 +251,8 @@ class LLMEngine:
                  dtype: Any = None,
                  temperature: Optional[float] = None,
                  top_k: Optional[int] = None,
-                 prefix_sharing: Optional[bool] = None):
+                 prefix_sharing: Optional[bool] = None,
+                 attention_impl: Optional[str] = None):
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -281,7 +294,19 @@ class LLMEngine:
         self.num_pages = max(int(num_pages), 2)
         self.ctx_len = self.pages_per_seq * self.page_size
 
-        self._model = LlamaModel(cfg)
+        # decode attention implementation: "paged" routes decode steps
+        # through the Pallas paged-attention kernel (block tables +
+        # context lengths, cost tracks used context); "dense" keeps the
+        # gather-then-dense reference (cost tracks max context).
+        impl = str(attention_impl or config.llm_attention_impl).lower()
+        if impl == "auto":
+            impl = "paged"
+        if impl not in ("paged", "dense"):
+            raise ValueError(
+                f"llm_attention_impl must be auto|paged|dense, got {impl!r}")
+        self.attention_impl = impl
+        self._model = LlamaModel(
+            cfg, page_size=self.page_size if impl == "paged" else 0)
         if params is None:
             dummy = np.zeros((1, 8), np.int32)
             params = self._model.init(
@@ -342,6 +367,11 @@ class LLMEngine:
         self._last_step_tokens = 0
         self._metrics = None
         self._warm = False
+        self._paged_warm = False
+        # decode-step accumulators (bench A/B reads mean step cost as
+        # a delta between two stats() snapshots)
+        self._decode_steps = 0
+        self._decode_secs = 0.0
         # EWMA of one engine step's wall time — the deadline-admission
         # estimate of "prefill + one decode step" cost (0 until the
         # first measured step; cold engines only refuse already-expired
@@ -531,7 +561,7 @@ class LLMEngine:
     # ------------------------------------------------------------- stepping
 
     def _forward(self, tokens, slot_arr, ctx, ctx_pos, ctx_mask, q_pos,
-                 last_idx):
+                 last_idx, block_tables=None, context_lens=None):
         """One jitted forward with this engine's static sampling knobs;
         the per-call rng split only happens on the sampling path, so
         greedy engines run the exact pre-sampling program."""
@@ -543,7 +573,42 @@ class LLMEngine:
         return self._step_fn(
             self._model, self._params, self._pools["k"], self._pools["v"],
             tokens, slot_arr, ctx, ctx_pos, ctx_mask, q_pos, last_idx,
-            temperature=self.temperature, top_k=self.top_k, rng=rng)
+            temperature=self.temperature, top_k=self.top_k, rng=rng,
+            block_tables=block_tables, context_lens=context_lens)
+
+    def _paged_width_buckets(self) -> List[int]:
+        """Block-table width buckets the paged decode path can emit:
+        powers of four from 4 up to (and capped at) pages_per_seq.
+        Coarser-than-pow-2 buckets trade at most a 4x width overshoot
+        at small contexts (cheap: unused pages are predicated off and
+        their copies deduped) for half the per-bucket jit compiles the
+        warm-up burst has to pay."""
+        widths, w = [], 4
+        while True:
+            widths.append(min(w, self.pages_per_seq))
+            if w >= self.pages_per_seq:
+                return widths
+            w *= 4
+
+    def _warm_paged_buckets(self) -> None:
+        """Compile every paged block-table width bucket up front, at
+        the FIRST decode step.  A bucket-crossing jit compile costs
+        seconds (interpret mode especially), and a compile stalling a
+        DEADLINED in-flight request past deadline_force_cancel_grace_s
+        gets the whole worker force-killed — so pay all compiles in one
+        burst while nothing is at stake (the deployment warm-up request
+        lands here).  The dummy forwards run garbage lanes only (slot
+        0, context length 0); the jit cache is process-wide, so engines
+        sharing a config/geometry pay once."""
+        np = self._np
+        b = self.max_batch
+        zeros1 = np.zeros((b, 1), np.int32)
+        for width in self._paged_width_buckets():
+            _tok, self._pools = self._forward(
+                zeros1, zeros1, None, None, None, zeros1,
+                np.zeros((b,), np.int32),
+                block_tables=np.zeros((b, width), np.int32),
+                context_lens=np.zeros((b,), np.int32))
 
     def _alloc_pages(self, n: int) -> List[int]:
         pages = self._free_pages[:n]
@@ -920,9 +985,12 @@ class LLMEngine:
             for seq in decode[:self.max_batch]:
                 last = (seq.generated[-1] if seq.generated
                         else seq.prefill_tokens[-1])
+                # snapshot the block table under the lock: a concurrent
+                # CoW split may rewrite entries after we release it
                 decode_args.append(
                     (seq, last, seq.slot_cache[seq.pos],
-                     seq.slot_cache[:seq.pos + 1]))
+                     seq.slot_cache[:seq.pos + 1],
+                     list(seq.block_table), seq.pos + 1))
         step_tokens = 0
         # ---- chunked prefill, batched across lanes: up to
         # prefill_lanes sequences advance one chunk each per step — a
@@ -977,24 +1045,57 @@ class LLMEngine:
             b = self.max_batch
             tokens = np.zeros((b, 1), np.int32)
             slot_arr = np.zeros((b, 1), np.int32)
-            ctx = np.zeros((b, self.ctx_len), np.int32)
-            ctx_pos = np.zeros((b, self.ctx_len), np.int32)
-            ctx_mask = np.zeros((b, self.ctx_len), bool)
             q_pos = np.zeros((b, 1), np.int32)
             last_idx = np.zeros((b,), np.int32)
-            for lane, (seq, last, slot, ctx_slots) in enumerate(decode_args):
-                tokens[lane, 0] = last
-                slot_arr[lane, 0] = slot
-                n = len(ctx_slots)
-                ctx[lane, :n] = ctx_slots
-                ctx_pos[lane, :n] = self._arange[:n]
-                ctx_mask[lane, :n] = True
-                q_pos[lane, 0] = seq.pos
-            next_tok, self._pools = self._forward(
-                tokens, slot_arr, ctx, ctx_pos, ctx_mask, q_pos, last_idx)
-            next_tok = np.asarray(next_tok)
+            if self.attention_impl == "paged" and not self._paged_warm:
+                self._paged_warm = True
+                self._warm_paged_buckets()
+            t_dec = time.perf_counter()
+            if self.attention_impl == "paged":
+                # page-granular context: block tables + context lengths
+                # instead of [B, ctx_len] gather/mask arrays.  The table
+                # width snaps to the smallest _paged_width_buckets()
+                # entry covering the max used pages across lanes:
+                # decode cost tracks USED context, and the jit retrace
+                # per bucket is O(log pages_per_seq) traces total.
+                max_used = max(-(-n // self.page_size)
+                               for *_a, n in decode_args)
+                width = next(w for w in self._paged_width_buckets()
+                             if w >= max_used)
+                block_tables = np.zeros((b, width), np.int32)
+                context_lens = np.zeros((b,), np.int32)
+                for lane, (seq, last, slot, _ctx, table, n) \
+                        in enumerate(decode_args):
+                    tokens[lane, 0] = last
+                    slot_arr[lane, 0] = slot
+                    used = -(-n // self.page_size)
+                    block_tables[lane, :used] = table[:used]
+                    context_lens[lane] = n
+                    q_pos[lane, 0] = seq.pos
+                next_tok, self._pools = self._forward(
+                    tokens, slot_arr, None, None, None, q_pos, last_idx,
+                    block_tables=block_tables, context_lens=context_lens)
+            else:
+                ctx = np.zeros((b, self.ctx_len), np.int32)
+                ctx_pos = np.zeros((b, self.ctx_len), np.int32)
+                ctx_mask = np.zeros((b, self.ctx_len), bool)
+                for lane, (seq, last, slot, ctx_slots, _table, n) \
+                        in enumerate(decode_args):
+                    tokens[lane, 0] = last
+                    slot_arr[lane, 0] = slot
+                    ctx[lane, :n] = ctx_slots
+                    ctx_pos[lane, :n] = self._arange[:n]
+                    ctx_mask[lane, :n] = True
+                    q_pos[lane, 0] = seq.pos
+                next_tok, self._pools = self._forward(
+                    tokens, slot_arr, ctx, ctx_pos, ctx_mask, q_pos,
+                    last_idx)
+            next_tok = np.asarray(next_tok)  # device sync: real step cost
+            decode_dt = time.perf_counter() - t_dec
+            self._decode_steps += 1
+            self._decode_secs += decode_dt
             with self._lock:
-                for lane, (seq, _last, _slot, _ctx) in enumerate(decode_args):
+                for lane, (seq, *_rest) in enumerate(decode_args):
                     if seq.done:
                         continue  # cancelled while we computed
                     seq.pos += 1
@@ -1003,6 +1104,7 @@ class LLMEngine:
             m = self.metrics()
             if m is not None:
                 m["tokens"].inc(len(decode_args), tags={"phase": "decode"})
+                m["decode_step"].observe(decode_dt)
         self._steps += 1
         self._last_batch = len(decode_args)
         self._last_step_tokens = step_tokens
@@ -1091,11 +1193,13 @@ class LLMEngine:
                 from ray_tpu._private.metrics import (llm_metrics,
                                                       llm_prefix_metrics)
 
-                tokens, pages, batch, ttft, queue, tps = llm_metrics()
+                (tokens, pages, batch, ttft, queue, tps,
+                 decode_step) = llm_metrics()
                 prefix_hits, shipped = llm_prefix_metrics()
                 self._metrics = {"tokens": tokens, "pages": pages,
                                  "batch": batch, "ttft": ttft,
                                  "queue": queue, "tps": tps,
+                                 "decode_step": decode_step,
                                  "prefix_hits": prefix_hits,
                                  "shipped": shipped}
             except Exception:
@@ -1121,6 +1225,9 @@ class LLMEngine:
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             return {"steps": self._steps,
+                    "attention_impl": self.attention_impl,
+                    "decode_steps": self._decode_steps,
+                    "decode_secs": self._decode_secs,
                     "queued": len(self._queued),
                     "active": len(self._active),
                     "cancelled": self._cancelled_total,

@@ -360,3 +360,27 @@ def test_single_loop_compat_mode(single_loop_cluster):
     # heartbeat-fed surfaces still flow on the single loop
     ts = _head().call("timeseries", timeout=30)
     assert isinstance(ts.get("series"), list)
+
+
+# ------------------------------------------------------------- static scan
+
+
+def test_no_bare_get_event_loop_anywhere():
+    """Lock in the multi-loop cleanup: every loop lookup in the package
+    must be ``asyncio.get_running_loop()``.  Bare ``get_event_loop()``
+    silently creates a NEW loop on a non-main thread (and a deprecated
+    implicit one on the main thread), which breaks the per-op loop
+    routing the sharded head relies on — a regression here reintroduces
+    cross-loop futures that never resolve."""
+    import pathlib
+
+    pkg = pathlib.Path(__file__).resolve().parent.parent / "ray_tpu"
+    offenders = []
+    for path in sorted(pkg.rglob("*.py")):
+        for ln, line in enumerate(path.read_text().splitlines(), 1):
+            if "get_event_loop(" in line and "get_running_loop(" not in line:
+                offenders.append(f"{path.relative_to(pkg.parent)}:{ln}: "
+                                 f"{line.strip()}")
+    assert not offenders, (
+        "bare asyncio.get_event_loop() found (use get_running_loop):\n"
+        + "\n".join(offenders))

@@ -96,6 +96,60 @@ def test_flash_attention_backward():
                                atol=2e-4, rtol=2e-4)
 
 
+def test_default_attention_routes_long_prefill_through_flash(monkeypatch):
+    """A/B equivalence for the length-threshold routing: at or above
+    FLASH_PREFILL_MIN_SEQ (and a multiple of the flash block),
+    default_attention must go through the Pallas flash kernel and agree
+    with the dense math it replaces."""
+    jax = force_cpu_jax()
+    from ray_tpu.models import llama
+    from ray_tpu.ops import flash_attention as fa
+
+    calls = []
+    real = fa.flash_attention
+
+    def spy(q, k, v, *a, **kw):
+        calls.append(tuple(q.shape))
+        return real(q, k, v, *a, **kw)
+
+    monkeypatch.setattr(fa, "flash_attention", spy)
+    monkeypatch.setattr(llama, "FLASH_PREFILL_MIN_SEQ", 128)
+    q, k, v = _qkv(jax, S=128, D=32)
+    routed = llama.default_attention(q, k, v, causal=True)
+    assert calls, "long causal prefill did not route through flash"
+    dense = llama.dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(routed), np.asarray(dense),
+                               atol=2e-5, rtol=2e-5)
+    # grad still traces through the routed path: flash carries a
+    # dense-recompute custom_vjp that targets dense_attention directly
+    # — if it routed back through default_attention this trace would
+    # recurse forever.  (Backward NUMERICS are covered by
+    # test_flash_attention_backward; tracing alone proves the wiring
+    # without paying a second kernel compile.)
+    jax.make_jaxpr(
+        jax.grad(lambda q: llama.default_attention(q, k, v).sum()))(q)
+
+
+def test_default_attention_short_or_unaligned_stays_dense(monkeypatch):
+    """Below the threshold, non-causal, cross-attention (s != t), or
+    non-128-multiple sequences keep the XLA dense path."""
+    jax = force_cpu_jax()
+    from ray_tpu.models import llama
+    from ray_tpu.ops import flash_attention as fa
+
+    def boom(*a, **kw):
+        raise AssertionError("flash kernel must not be used here")
+
+    monkeypatch.setattr(fa, "flash_attention", boom)
+    monkeypatch.setattr(llama, "FLASH_PREFILL_MIN_SEQ", 128)
+    q, k, v = _qkv(jax, S=64, D=32)
+    llama.default_attention(q, k, v, causal=True)       # short
+    llama.default_attention(q, k, v, causal=False)      # non-causal
+    q2, k2, v2 = _qkv(jax, S=192, D=32)
+    monkeypatch.setattr(llama, "FLASH_PREFILL_MIN_SEQ", 200)
+    llama.default_attention(q2, k2, v2, causal=True)    # below threshold
+
+
 def test_llama_trains_with_sequence_parallelism():
     jax = force_cpu_jax()
     import jax.numpy as jnp
